@@ -1,0 +1,62 @@
+"""Array ingestion and validation helpers.
+
+The reference's ``mdspan``/``mdarray`` machinery (``core/mdarray.hpp``,
+``core/host_device_accessor.hpp``) exists to give C++ a shape/layout-checked,
+memory-space-aware view type; in JAX that role is played by ``jax.Array``
+itself. What remains is the *ingestion* contract from pylibraft
+(``cai_wrapper`` accepting any ``__cuda_array_interface__`` object): here any
+``__array__``/dlpack-capable object — numpy, JAX, torch(cpu) — is accepted
+and validated. ``memory_type_dispatcher`` (host-vs-device routing,
+``util/memory_type_dispatcher.cuh:48-118``) reduces to ``jax.device_put``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.errors import expects
+
+
+def as_array(x, dtype=None, ndim: Optional[int] = None, name: str = "array") -> jax.Array:
+    """Convert ``x`` (numpy / jax / torch / dlpack / buffer) to a jax.Array.
+
+    Validation analog of the pylibraft wrappers' dtype/shape checks
+    (``neighbors/ivf_pq/ivf_pq.pyx:359-375``).
+    """
+    if isinstance(x, jax.Array):
+        arr = x
+    elif hasattr(x, "__dlpack__") and not isinstance(x, np.ndarray):
+        try:
+            arr = jnp.from_dlpack(x)
+        except Exception:
+            arr = jnp.asarray(np.asarray(x))
+    else:
+        arr = jnp.asarray(x)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    if ndim is not None:
+        expects(arr.ndim == ndim, "%s must be %d-dimensional, got %d", name, ndim, arr.ndim)
+    return arr
+
+
+def check_matching_dims(a: jax.Array, b: jax.Array, axis_a: int, axis_b: int, what: str) -> None:
+    expects(
+        a.shape[axis_a] == b.shape[axis_b],
+        "%s: dimension mismatch (%d vs %d)",
+        what,
+        a.shape[axis_a],
+        b.shape[axis_b],
+    )
+
+
+def check_dtype_one_of(arr: jax.Array, dtypes: Sequence, name: str = "array") -> None:
+    expects(
+        any(arr.dtype == jnp.dtype(d) for d in dtypes),
+        "%s: unsupported dtype %s (expected one of %s)",
+        name,
+        arr.dtype,
+        [jnp.dtype(d).name for d in dtypes],
+    )

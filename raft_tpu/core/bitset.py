@@ -1,0 +1,149 @@
+"""Packed bitset / bitmap used as ANN search prefilters.
+
+Analog of ``core/bitset.hpp:39,119`` (``bitset_view`` / ``bitset``) and
+``core/bitmap.hpp:43`` in the reference, where bitsets mark deleted/filtered
+dataset rows and are tested inside IVF/CAGRA/brute-force kernels. Here a
+bitset is a flat ``uint32`` JAX array (a pytree), and all operations are pure
+functions usable under ``jit`` — tests map onto VPU bitwise ops.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.math import cdiv
+
+_BITS = 32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Bitset:
+    """A fixed-size set of bits over ``[0, size)``; bit=1 means "keep".
+
+    Mirrors ``raft::core::bitset``: created either empty (all set / all unset)
+    or from a list of indices to *unset* (the deleted-rows use case,
+    ``bitset.hpp`` ctor with ``mask_index``).
+    """
+
+    bits: jax.Array  # uint32[ceil(size/32)]
+    size: int
+
+    def tree_flatten(self):
+        return (self.bits,), (self.size,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(bits=children[0], size=aux[0])
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def create(size: int, default: bool = True) -> "Bitset":
+        n_words = cdiv(size, _BITS)
+        fill = jnp.uint32(0xFFFFFFFF) if default else jnp.uint32(0)
+        bits = jnp.full((n_words,), fill, dtype=jnp.uint32)
+        if default and size % _BITS:
+            # Mask tail bits beyond `size` so count() is exact.
+            tail = jnp.uint32((1 << (size % _BITS)) - 1)
+            bits = bits.at[-1].set(tail)
+        return Bitset(bits=bits, size=size)
+
+    @staticmethod
+    def from_mask(mask: jax.Array) -> "Bitset":
+        """Pack a boolean vector (True = keep) into a bitset."""
+        size = mask.shape[0]
+        n_words = cdiv(size, _BITS)
+        pad = n_words * _BITS - size
+        m = jnp.pad(mask.astype(jnp.uint32), (0, pad)).reshape(n_words, _BITS)
+        weights = (jnp.uint32(1) << jnp.arange(_BITS, dtype=jnp.uint32))[None, :]
+        return Bitset(bits=(m * weights).sum(axis=1).astype(jnp.uint32), size=size)
+
+    @staticmethod
+    def from_unset_indices(size: int, indices: jax.Array) -> "Bitset":
+        """All-set bitset with ``indices`` cleared (deleted-rows ctor)."""
+        return Bitset.create(size, default=True).unset(indices)
+
+    # -- element ops -------------------------------------------------------
+    def test(self, indices: jax.Array) -> jax.Array:
+        """Gather bit values at ``indices`` -> bool array."""
+        word = self.bits[indices // _BITS]
+        return ((word >> (indices % _BITS).astype(jnp.uint32)) & 1).astype(bool)
+
+    def set(self, indices: jax.Array) -> "Bitset":
+        # Scattered OR: apply one index at a time so duplicates within a word
+        # fold correctly (jnp scatter .set would keep only one of them).
+        sel = jnp.uint32(1) << (indices % _BITS).astype(jnp.uint32)
+
+        def body(bits, iw):
+            i, w = iw
+            return bits.at[i].set(bits[i] | w), None
+
+        bits, _ = jax.lax.scan(body, self.bits, (indices // _BITS, sel))
+        return Bitset(bits=bits, size=self.size)
+
+    def unset(self, indices: jax.Array) -> "Bitset":
+        # Scattered AND-NOT, same per-index fold as set().
+        sel = ~(jnp.uint32(1) << (indices % _BITS).astype(jnp.uint32))
+
+        def body(bits, iw):
+            i, w = iw
+            return bits.at[i].set(bits[i] & w), None
+
+        bits, _ = jax.lax.scan(body, self.bits, (indices // _BITS, sel))
+        return Bitset(bits=bits, size=self.size)
+
+    def flip(self) -> "Bitset":
+        bits = ~self.bits
+        if self.size % _BITS:
+            tail = jnp.uint32((1 << (self.size % _BITS)) - 1)
+            bits = bits.at[-1].set(bits[-1] & tail)
+        return Bitset(bits=bits, size=self.size)
+
+    def count(self) -> jax.Array:
+        """Number of set bits (analog of ``bitset::count``)."""
+        return jnp.sum(popcount32(self.bits))
+
+    def to_mask(self) -> jax.Array:
+        """Unpack into a bool[size] vector (for masking distance tiles)."""
+        shifts = jnp.arange(_BITS, dtype=jnp.uint32)[None, :]
+        unpacked = ((self.bits[:, None] >> shifts) & 1).astype(bool)
+        return unpacked.reshape(-1)[: self.size]
+
+
+# Bitmap = 2D bitset view (rows x cols), used for per-query filters
+# (core/bitmap.hpp). Represent as a Bitset over row-major flattened indices.
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Bitmap:
+    bitset: Bitset
+    rows: int
+    cols: int
+
+    def tree_flatten(self):
+        return (self.bitset,), (self.rows, self.cols)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(bitset=children[0], rows=aux[0], cols=aux[1])
+
+    @staticmethod
+    def from_mask(mask2d: jax.Array) -> "Bitmap":
+        rows, cols = mask2d.shape
+        return Bitmap(Bitset.from_mask(mask2d.reshape(-1)), rows, cols)
+
+    def test(self, row: jax.Array, col: jax.Array) -> jax.Array:
+        return self.bitset.test(row * self.cols + col)
+
+    def to_mask(self) -> jax.Array:
+        return self.bitset.to_mask().reshape(self.rows, self.cols)
+
+
+def popcount32(x: jax.Array) -> jax.Array:
+    """Per-element population count of a uint32 array (SWAR)."""
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)

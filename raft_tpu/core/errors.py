@@ -1,0 +1,25 @@
+"""Exception types and check macros.
+
+Analog of ``core/error.hpp:48,229,245``: ``raft::exception`` (with backtrace —
+Python gives us that for free), ``RAFT_EXPECTS`` and ``RAFT_FAIL``.
+"""
+from __future__ import annotations
+
+
+class RaftError(RuntimeError):
+    """Base library exception (analog of ``raft::exception``)."""
+
+
+class LogicError(RaftError):
+    """Analog of ``raft::logic_error`` raised by ``RAFT_EXPECTS``."""
+
+
+def expects(cond: bool, msg: str, *args) -> None:
+    """Runtime check macro analog of ``RAFT_EXPECTS(cond, fmt, ...)``."""
+    if not cond:
+        raise LogicError(msg % args if args else msg)
+
+
+def fail(msg: str, *args) -> None:
+    """Unconditional failure (``RAFT_FAIL``)."""
+    raise LogicError(msg % args if args else msg)

@@ -1,0 +1,70 @@
+"""Cooperative cancellation of long-running host-side loops.
+
+Analog of ``core/interruptible.hpp:73-170``: the reference's spin-wait stream
+sync polls a per-thread token so another thread can cancel in-flight GPU work.
+On TPU, device work inside one jitted computation is not interruptible (XLA
+runs the whole program), but the library's long-running *host* loops — batched
+index builds, NN-descent rounds, benchmark sweeps — poll ``synchronize()``
+between device calls, giving equivalent cancellation granularity to the
+reference's between-kernel checks. Exposed to users exactly like the pylibraft
+wrapper (``pylibraft/common/interruptible.pyx``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from raft_tpu.core.errors import RaftError
+
+
+class InterruptedException(RaftError):
+    """Raised inside a cancelled thread at its next synchronize() point."""
+
+
+_tokens: Dict[int, threading.Event] = {}
+_lock = threading.Lock()
+
+
+def _token(tid: int | None = None) -> threading.Event:
+    tid = threading.get_ident() if tid is None else tid
+    with _lock:
+        ev = _tokens.get(tid)
+        if ev is None:
+            ev = threading.Event()
+            _tokens[tid] = ev
+        return ev
+
+
+def cancel(thread_id: int) -> None:
+    """Request cancellation of another thread (``interruptible::cancel``)."""
+    _token(thread_id).set()
+
+
+def yield_() -> None:
+    """Check-and-clear the current thread's token, raising if cancelled
+    (``interruptible::yield``)."""
+    ev = _token()
+    if ev.is_set():
+        ev.clear()
+        raise InterruptedException("raft_tpu: computation interrupted")
+
+
+def yield_no_throw() -> bool:
+    """Check-and-clear; returns True if a cancellation was pending."""
+    ev = _token()
+    if ev.is_set():
+        ev.clear()
+        return True
+    return False
+
+
+def synchronize(value=None):
+    """Cancellation-aware sync point: block on ``value`` (if given) and poll
+    the token (analog of ``interruptible::synchronize(stream)``)."""
+    yield_()
+    if value is not None:
+        import jax
+
+        jax.block_until_ready(value)
+        yield_()
+    return value

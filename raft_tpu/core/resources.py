@@ -1,0 +1,131 @@
+"""Execution-context container: the TPU-native analog of ``raft::resources``.
+
+The reference threads a ``raft::resources const& handle`` through every API
+(``cpp/include/raft/core/resources.hpp:49``): a type-indexed registry holding
+the CUDA stream, BLAS handles, workspace allocator and communicator
+(``core/resource/resource_types.hpp:29-51``). On TPU/JAX nearly all of those
+slots dissolve — XLA owns streams and fusion, and there are no BLAS handles —
+but three responsibilities survive and live here:
+
+* device / mesh placement (the COMMUNICATOR / SUB_COMMUNICATOR slots,
+  ``core/resource/resource_types.hpp:38-39``, map to `jax.sharding.Mesh` axes),
+* a counter-based RNG key stream (the ``rng_state`` the reference passes
+  explicitly),
+* a workspace byte budget used by batching heuristics (the analog of
+  ``workspace_resource_factory::default_workspace_size``,
+  ``core/resource/device_memory_resource.hpp:106``).
+
+Like the reference's handle, ``Resources`` is cheap to copy, lazily
+initialized, and optional: every public API accepts ``res=None`` and falls
+back to a process-global default (mirroring pylibraft's ``auto_sync_handle``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _default_device() -> jax.Device:
+    return jax.devices()[0]
+
+
+@dataclasses.dataclass
+class Resources:
+    """Per-call execution context.
+
+    Parameters
+    ----------
+    device:
+        The JAX device new arrays should be placed on. Defaults to
+        ``jax.devices()[0]``.
+    mesh:
+        Optional `jax.sharding.Mesh` for multi-chip execution. Set by
+        :func:`raft_tpu.parallel.comms.init_comms`; algorithms fetch it via
+        :meth:`get_mesh` (the analog of ``resource::get_comms(handle)``).
+    seed:
+        Seed for the resource-owned RNG key stream.
+    workspace_bytes:
+        Byte budget batching heuristics may assume for temporaries. Mirrors
+        the reference's limited workspace resource (default there: 1/4 of
+        free memory; here: a conservative 1 GiB of HBM).
+    """
+
+    device: Optional[jax.Device] = None
+    mesh: Optional[jax.sharding.Mesh] = None
+    seed: int = 0
+    workspace_bytes: int = 1 << 30
+
+    def __post_init__(self):
+        if self.device is None:
+            self.device = _default_device()
+        self._key = jax.random.key(self.seed)
+        self._lock = threading.Lock()
+        self._registry: dict[str, Any] = {}
+
+    # -- RNG key stream ----------------------------------------------------
+    def next_key(self, n: Optional[int] = None):
+        """Split off fresh PRNG key(s) from the resource-owned stream."""
+        with self._lock:
+            if n is None:
+                self._key, sub = jax.random.split(self._key)
+                return sub
+            keys = jax.random.split(self._key, n + 1)
+            self._key = keys[0]
+            return keys[1:]
+
+    # -- mesh / comms ------------------------------------------------------
+    def get_mesh(self) -> jax.sharding.Mesh:
+        if self.mesh is None:
+            raise ValueError(
+                "No mesh set on Resources; call raft_tpu.parallel.init_comms() "
+                "or pass mesh= explicitly (analog of resource::get_comms on a "
+                "handle without a communicator)."
+            )
+        return self.mesh
+
+    def has_mesh(self) -> bool:
+        return self.mesh is not None
+
+    # -- generic registry (analog of custom resources) ---------------------
+    def set_resource(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._registry[name] = value
+
+    def get_resource(self, name: str, factory=None) -> Any:
+        """Lazily fetch a named resource, creating it with ``factory``."""
+        with self._lock:
+            if name not in self._registry:
+                if factory is None:
+                    raise KeyError(name)
+                self._registry[name] = factory()
+            return self._registry[name]
+
+    def sync(self) -> None:
+        """Block until all queued work on this device is complete.
+
+        Analog of ``resource::sync_stream``; JAX is async-dispatch so this
+        just fences with a trivial transfer.
+        """
+        jax.block_until_ready(jax.device_put(np.zeros(()), self.device))
+
+
+_default_resources: Optional[Resources] = None
+_default_lock = threading.Lock()
+
+
+def default_resources() -> Resources:
+    """Process-global default handle (lazy; analog of pylibraft's implicit
+    ``DeviceResources`` injected by ``auto_sync_handle``)."""
+    global _default_resources
+    with _default_lock:
+        if _default_resources is None:
+            _default_resources = Resources()
+        return _default_resources
+
+
+def ensure_resources(res: Optional[Resources]) -> Resources:
+    return res if res is not None else default_resources()

@@ -1,0 +1,113 @@
+"""Array/scalar (de)serialization in NumPy ``.npy`` format.
+
+Analog of ``core/serialize.hpp:36-126`` / ``core/detail/numpy_serializer.hpp``
+in the reference: mdspans are written to iostreams in the npy format so
+indexes serialized by one implementation can be inspected (or loaded) by
+numpy. Index-level serializers (brute-force / IVF-Flat / IVF-PQ / CAGRA) are
+built from these primitives plus a versioned header, mirroring
+``neighbors/*_serialize.cuh``.
+"""
+from __future__ import annotations
+
+import io
+import struct
+from typing import BinaryIO, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Serialization format version tag written by dump_header; bump on breaking
+# layout changes (the reference keeps a per-index `serialization_version`).
+SERIALIZATION_VERSION = 1
+_MAGIC = b"RAFT_TPU"
+
+
+# Dtypes npy cannot represent, stored via a bit-identical view. The dtype
+# name is tagged ahead of the npy payload so deserialize restores it.
+_VIEW_AS = {"bfloat16": np.uint16}
+
+
+def serialize_array(stream: BinaryIO, arr) -> None:
+    """Write an array: a dtype-name tag followed by an ``.npy`` payload.
+
+    Analog of ``serialize_mdspan`` (``core/serialize.hpp:99``). The npy
+    payload stays numpy-loadable; bfloat16 (not representable in npy) is
+    stored as a uint16 bit view and restored from the tag.
+    """
+    host = np.asarray(jax.device_get(arr))
+    name = host.dtype.name
+    serialize_string(stream, name)
+    if name in _VIEW_AS:
+        host = host.view(_VIEW_AS[name])
+    np.save(stream, host, allow_pickle=False)
+
+
+def deserialize_array(stream: BinaryIO, device=None) -> jax.Array:
+    """Read one tagged array and place it on ``device``.
+
+    Analog of ``deserialize_mdspan`` (``core/serialize.hpp:110``).
+    """
+    name = deserialize_string(stream)
+    host = np.load(stream, allow_pickle=False)
+    if name in _VIEW_AS:
+        host = host.view(jnp.dtype(name))
+    return jax.device_put(host, device)
+
+
+_SCALAR_FMT = {
+    "int32": "<i4",
+    "int64": "<i8",
+    "uint32": "<u4",
+    "uint64": "<u8",
+    "float32": "<f4",
+    "float64": "<f8",
+    "bool": "?",
+}
+
+
+def serialize_scalar(stream: BinaryIO, value: Union[int, float, bool, np.generic], dtype: str) -> None:
+    """Write one fixed-width scalar (analog of ``serialize_scalar``,
+    ``core/serialize.hpp:36``)."""
+    stream.write(np.asarray(value, dtype=_SCALAR_FMT[dtype]).tobytes())
+
+
+def deserialize_scalar(stream: BinaryIO, dtype: str):
+    dt = np.dtype(_SCALAR_FMT[dtype])
+    buf = stream.read(dt.itemsize)
+    if len(buf) != dt.itemsize:
+        raise EOFError("truncated stream while reading scalar")
+    return np.frombuffer(buf, dtype=dt)[0].item()
+
+
+def serialize_string(stream: BinaryIO, s: str) -> None:
+    data = s.encode("utf-8")
+    serialize_scalar(stream, len(data), "uint32")
+    stream.write(data)
+
+
+def deserialize_string(stream: BinaryIO) -> str:
+    n = deserialize_scalar(stream, "uint32")
+    return stream.read(n).decode("utf-8")
+
+
+def dump_header(stream: BinaryIO, kind: str, version: int = SERIALIZATION_VERSION) -> None:
+    """Write the magic + index-kind + version preamble used by all index
+    serializers (analog of the version tag checks in
+    ``neighbors/ivf_pq_serialize.cuh``)."""
+    stream.write(_MAGIC)
+    serialize_string(stream, kind)
+    serialize_scalar(stream, version, "uint32")
+
+
+def check_header(stream: BinaryIO, kind: str) -> int:
+    magic = stream.read(len(_MAGIC))
+    if magic != _MAGIC:
+        raise ValueError(f"not a raft_tpu serialized object (bad magic {magic!r})")
+    found = deserialize_string(stream)
+    if found != kind:
+        raise ValueError(f"expected serialized {kind!r}, found {found!r}")
+    version = deserialize_scalar(stream, "uint32")
+    if version > SERIALIZATION_VERSION:
+        raise ValueError(f"serialization version {version} is newer than supported {SERIALIZATION_VERSION}")
+    return version

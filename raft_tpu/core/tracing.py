@@ -1,0 +1,66 @@
+"""Profiler annotation scopes: the TPU-native analog of the reference's NVTX
+RAII ranges (``core/nvtx.hpp:26-93``) that mark every nontrivial entry point.
+
+On TPU the profiler is XPlane/Perfetto via ``jax.profiler``; a
+``TraceAnnotation`` shows up on the host timeline and a ``named_scope``
+attaches names to compiled HLO. Like the reference (compile-time NVTX gate,
+``cpp/CMakeLists.txt:261``) tracing is toggleable and zero-cost when off.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+
+import jax
+
+_enabled = os.environ.get("RAFT_TPU_TRACING", "1") != "0"
+
+
+def enable(flag: bool = True) -> None:
+    global _enabled
+    _enabled = flag
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def push_range(name: str):
+    """Host-side timeline range (analog of ``nvtx::push_range/pop_range``)."""
+    if not _enabled:
+        yield
+        return
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+# The RAII alias used throughout the reference: raft::common::nvtx::range.
+range = push_range
+
+
+def annotate(name: str | None = None):
+    """Decorator tracing a function (analog of the per-function NVTX ranges
+    at e.g. ``cluster/detail/kmeans.cuh:371``)."""
+
+    def deco(fn):
+        label = name or f"raft_tpu::{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _enabled:
+                return fn(*args, **kwargs)
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def named_scope(name: str):
+    """In-graph scope: names survive into compiled HLO/XPlane."""
+    if not _enabled:
+        return contextlib.nullcontext()
+    return jax.named_scope(name)

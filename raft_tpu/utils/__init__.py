@@ -1,0 +1,23 @@
+from raft_tpu.utils.math import (
+    LANES,
+    SUBLANES,
+    cdiv,
+    is_pow2,
+    next_pow2,
+    pad_to_lanes,
+    prev_pow2,
+    round_down,
+    round_up,
+)
+
+__all__ = [
+    "LANES",
+    "SUBLANES",
+    "cdiv",
+    "is_pow2",
+    "next_pow2",
+    "pad_to_lanes",
+    "prev_pow2",
+    "round_down",
+    "round_up",
+]

@@ -1,0 +1,39 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-GPU comms tests
+there run on a single node via LocalCUDACluster; here multi-chip sharding is
+validated on `xla_force_host_platform_device_count=8` CPU devices. Pallas
+kernels run in interpreter mode on CPU (handled inside the library).
+"""
+import os
+
+# XLA_FLAGS must be set before the CPU backend initializes. The platform
+# itself is forced via jax.config below — the environment may pin
+# JAX_PLATFORMS to a TPU plugin (e.g. axon) at interpreter start, which
+# overrides any env-var set here, so setdefault is not enough.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]

@@ -1,0 +1,171 @@
+"""Core-layer tests: resources, serialize, bitset, interruptible, errors.
+
+Mirrors the reference's ``cpp/test/core`` coverage (serialize round-trips,
+bitset semantics, interruptible cancellation).
+"""
+import io
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import (
+    Bitmap,
+    Bitset,
+    LogicError,
+    Resources,
+    as_array,
+    default_resources,
+    expects,
+    interruptible,
+    serialize,
+)
+
+
+class TestResources:
+    def test_default(self):
+        res = default_resources()
+        assert res.device is not None
+
+    def test_key_stream_distinct(self):
+        res = Resources(seed=7)
+        k1, k2 = res.next_key(), res.next_key()
+        assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+
+    def test_key_batch(self):
+        res = Resources(seed=3)
+        ks = res.next_key(5)
+        assert ks.shape[0] == 5
+
+    def test_registry(self):
+        res = Resources()
+        assert res.get_resource("x", lambda: 42) == 42
+        res.set_resource("x", 43)
+        assert res.get_resource("x") == 43
+
+    def test_mesh_missing_raises(self):
+        with pytest.raises(ValueError):
+            Resources().get_mesh()
+
+
+class TestSerialize:
+    @pytest.mark.parametrize("dtype", ["float32", "int32", "uint8"])
+    def test_array_roundtrip(self, rng, dtype):
+        x = rng.standard_normal((17, 9)).astype(dtype)
+        buf = io.BytesIO()
+        serialize.serialize_array(buf, jnp.asarray(x))
+        buf.seek(0)
+        y = serialize.deserialize_array(buf)
+        np.testing.assert_array_equal(np.asarray(y), x)
+
+    def test_scalar_and_string_roundtrip(self):
+        buf = io.BytesIO()
+        serialize.serialize_scalar(buf, 123, "int64")
+        serialize.serialize_scalar(buf, 0.5, "float32")
+        serialize.serialize_string(buf, "metric=L2Expanded")
+        buf.seek(0)
+        assert serialize.deserialize_scalar(buf, "int64") == 123
+        assert serialize.deserialize_scalar(buf, "float32") == 0.5
+        assert serialize.deserialize_string(buf) == "metric=L2Expanded"
+
+    def test_header_roundtrip(self):
+        buf = io.BytesIO()
+        serialize.dump_header(buf, "ivf_flat")
+        buf.seek(0)
+        assert serialize.check_header(buf, "ivf_flat") == serialize.SERIALIZATION_VERSION
+
+    def test_header_kind_mismatch(self):
+        buf = io.BytesIO()
+        serialize.dump_header(buf, "ivf_flat")
+        buf.seek(0)
+        with pytest.raises(ValueError):
+            serialize.check_header(buf, "cagra")
+
+
+class TestBitset:
+    def test_create_count(self):
+        bs = Bitset.create(100, default=True)
+        assert int(bs.count()) == 100
+        assert int(Bitset.create(100, default=False).count()) == 0
+
+    def test_roundtrip_mask(self, rng):
+        mask = rng.random(77) < 0.5
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        np.testing.assert_array_equal(np.asarray(bs.to_mask()), mask)
+        assert int(bs.count()) == mask.sum()
+
+    def test_set_unset_test(self):
+        bs = Bitset.create(64, default=False)
+        bs = bs.set(jnp.array([0, 5, 33]))
+        got = bs.test(jnp.array([0, 1, 5, 33, 63]))
+        np.testing.assert_array_equal(np.asarray(got), [True, False, True, True, False])
+        bs = bs.unset(jnp.array([5]))
+        assert not bool(bs.test(jnp.array([5]))[0])
+
+    def test_deleted_rows_ctor(self):
+        bs = Bitset.from_unset_indices(40, jnp.array([3, 17]))
+        assert int(bs.count()) == 38
+
+    def test_flip(self, rng):
+        mask = rng.random(50) < 0.3
+        bs = Bitset.from_mask(jnp.asarray(mask)).flip()
+        np.testing.assert_array_equal(np.asarray(bs.to_mask()), ~mask)
+
+    def test_jit_test(self):
+        bs = Bitset.from_mask(jnp.asarray(np.array([True, False, True])))
+        f = jax.jit(lambda b, i: b.test(i))
+        assert bool(f(bs, jnp.array([2]))[0])
+
+    def test_bitmap(self, rng):
+        m = rng.random((5, 9)) < 0.5
+        bm = Bitmap.from_mask(jnp.asarray(m))
+        np.testing.assert_array_equal(np.asarray(bm.to_mask()), m)
+        assert bool(bm.test(jnp.array(1), jnp.array(2))) == m[1, 2]
+
+
+class TestInterruptible:
+    def test_yield_no_throw(self):
+        assert not interruptible.yield_no_throw()
+
+    def test_cancel_other_thread(self):
+        caught = []
+
+        def worker():
+            ev.wait()
+            try:
+                interruptible.synchronize()
+            except interruptible.InterruptedException:
+                caught.append(True)
+
+        ev = threading.Event()
+        t = threading.Thread(target=worker)
+        t.start()
+        interruptible.cancel(t.ident)
+        ev.set()
+        t.join()
+        assert caught == [True]
+
+
+class TestErrors:
+    def test_expects(self):
+        expects(True, "fine")
+        with pytest.raises(LogicError):
+            expects(False, "bad value %d", 3)
+
+
+class TestAsArray:
+    def test_numpy(self):
+        a = as_array(np.ones((2, 3)), dtype=jnp.float32, ndim=2)
+        assert a.dtype == jnp.float32
+
+    def test_ndim_check(self):
+        with pytest.raises(LogicError):
+            as_array(np.ones(3), ndim=2)
+
+    def test_torch_cpu(self):
+        torch = pytest.importorskip("torch")
+        t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+        a = as_array(t, ndim=2)
+        np.testing.assert_allclose(np.asarray(a), t.numpy())
