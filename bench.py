@@ -1,0 +1,84 @@
+"""Driver-facing smoke benchmark: brute-force kNN QPS on SIFT-shaped data.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the round-1..N flagship path (exact kNN = pairwise distance +
+select_k, SURVEY.md §7 step 1's "minimum competency test") on synthetic
+SIFT-shaped data (128-d, L2), reporting queries/second at batch size 100 —
+the reference harness's ``items_per_second`` counter
+(``cpp/bench/ann/src/common/benchmark.hpp:330-385``).
+
+``vs_baseline``: BASELINE.md records no absolute reference QPS (the
+reference publishes only Pareto plots), so we normalize against a fixed
+nominal target of 50k QPS for brute-force SIFT-100k@k=10 — roughly what an
+A100 achieves on this shape with cuBLAS+select_k — making the ratio
+comparable across rounds.
+"""
+import json
+import time
+
+import numpy as np
+
+import jax
+
+N, D, NQ, K = 100_000, 128, 1000, 10
+BATCH = 100
+NOMINAL_BASELINE_QPS = 50_000.0
+
+
+def main():
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.ops import DistanceType
+    from raft_tpu.stats import neighborhood_recall
+
+    rng = np.random.default_rng(42)
+    dataset = rng.standard_normal((N, D), dtype=np.float32)
+    queries = rng.standard_normal((NQ, D), dtype=np.float32)
+
+    index = brute_force.build(dataset, metric=DistanceType.L2Expanded)
+    jax.block_until_ready(index.dataset)
+
+    # Warmup (compile)
+    d, i = brute_force.search(index, queries[:BATCH], K, query_batch=BATCH)
+    jax.block_until_ready((d, i))
+
+    # Timed: sweep all queries in batches
+    t0 = time.perf_counter()
+    outs = []
+    for s in range(0, NQ, BATCH):
+        outs.append(brute_force.search(index, queries[s : s + BATCH], K, query_batch=BATCH))
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    qps = NQ / dt
+
+    # Sampled recall sanity vs exact numpy on a small subset.
+    sub = 50
+    d2 = ((queries[:sub, None, :] - dataset[None, :2000, :]) ** 2).sum(-1)
+    ref_idx = np.argsort(d2, axis=1)[:, :K]
+    sub_idx = np.asarray(brute_force.search(
+        brute_force.build(dataset[:2000], metric=DistanceType.L2Expanded),
+        queries[:sub], K)[1])
+    recall = float(neighborhood_recall(sub_idx, ref_idx))
+
+    print(
+        json.dumps(
+            {
+                "metric": "bf_knn_qps_sift100k_k10_b100",
+                "value": round(qps, 2),
+                "unit": "qps",
+                "vs_baseline": round(qps / NOMINAL_BASELINE_QPS, 4),
+                "extra": {
+                    "n": N,
+                    "d": D,
+                    "k": K,
+                    "batch": BATCH,
+                    "recall_sampled": round(recall, 4),
+                    "device": str(jax.devices()[0].platform),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""ANN index layer (L5 analog): brute-force, IVF-Flat, IVF-PQ, CAGRA,
+NN-descent, refine, filters.
+
+See ``SURVEY.md`` §2.4 (``/root/reference/cpp/include/raft/neighbors``).
+"""
+from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors.refine import refine
+
+__all__ = ["brute_force", "refine"]

@@ -1,0 +1,282 @@
+"""Brute-force (exact) kNN index — analog of ``raft::neighbors::brute_force``.
+
+The reference implements exact search as tiled pairwise distance + select_k
+with a k>tile merge path (``neighbors/detail/knn_brute_force.cuh:60``
+``tiled_brute_force_knn``, ``:326`` ``brute_force_knn_impl``) behind a
+persistent index type holding the dataset and its precomputed norms
+(``neighbors/brute_force_types.hpp:49``).
+
+TPU design: the index is a pytree (dataset + f32 squared norms + static
+metric), search is a single jitted function that ``lax.scan``s over dataset
+tiles computing each [query_batch, tile] distance block on the MXU and
+folding a running top-k carry (see :func:`raft_tpu.ops.select_k.running_merge`)
+— so peak memory is O(batch * tile), never O(batch * n). Queries are batched
+on the host like the reference's query iterator
+(``knn_brute_force.cuh:440-480``). Prefiltering consumes
+:class:`raft_tpu.core.Bitset` (``sample_filter_types.hpp:27`` analog).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import BinaryIO, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.errors import expects
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import (
+    DistanceType,
+    _EXPANDED,
+    _accum_step,
+    _expanded_distance,
+    is_min_close,
+    resolve_metric,
+    row_norms,
+)
+from raft_tpu.ops.select_k import running_merge, select_k, worst_value
+from raft_tpu.utils.math import cdiv
+
+_NORM_METRICS = frozenset(
+    {
+        DistanceType.L2Expanded,
+        DistanceType.L2SqrtExpanded,
+        DistanceType.CosineExpanded,
+    }
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BruteForceIndex:
+    """Persistent exact-kNN index (``brute_force_types.hpp:49`` analog)."""
+
+    dataset: jax.Array  # [n_rows, dim]
+    norms: Optional[jax.Array]  # [n_rows] f32 squared L2 norms, or None
+    metric: DistanceType
+    metric_arg: float
+
+    def tree_flatten(self):
+        return (self.dataset, self.norms), (self.metric, self.metric_arg)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(dataset=children[0], norms=children[1], metric=aux[0], metric_arg=aux[1])
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+
+def build(
+    dataset,
+    metric=DistanceType.L2SqrtExpanded,
+    metric_arg: float = 2.0,
+    res: Optional[Resources] = None,
+) -> BruteForceIndex:
+    """Build the index: store the dataset and precompute squared row norms
+    for expanded metrics (``brute_force_knn_impl``'s norm precompute,
+    ``knn_brute_force.cuh:352-370``)."""
+    ensure_resources(res)
+    metric = resolve_metric(metric)
+    dataset = jnp.asarray(dataset)
+    expects(dataset.ndim == 2, "dataset must be [n_rows, dim]")
+    norms = row_norms(dataset) if metric in _NORM_METRICS else None
+    return BruteForceIndex(dataset=dataset, norms=norms, metric=metric, metric_arg=float(metric_arg))
+
+
+def _tile_distances(q, q_sqnorm, y_tile, yn_tile, metric: DistanceType, p: float):
+    """One [batch, tile] distance block. Expanded metrics ride the MXU with
+    precomputed norms; accumulation metrics broadcast directly (the tile is
+    small so m*tile*d temp is bounded by the tile size choice)."""
+    if metric in _EXPANDED:
+        return _expanded_distance(q, y_tile, metric, q_sqnorm, yn_tile)
+    from raft_tpu.ops.distance import _accum_combine, _accum_finalize  # local: keep import surface small
+
+    qf = q.astype(jnp.float32)
+    yf = y_tile.astype(jnp.float32)
+    acc = _accum_step(qf, yf, metric, p)
+    return _accum_finalize(acc, metric, p, q.shape[1])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "metric", "p", "tile", "select_min", "has_filter")
+)
+def _search_impl(
+    dataset,
+    norms,
+    queries,
+    filter_mask,
+    *,
+    k: int,
+    metric: DistanceType,
+    p: float,
+    tile: int,
+    select_min: bool,
+    has_filter: bool,
+):
+    n, d = dataset.shape
+    qb = queries.shape[0]
+    n_tiles = cdiv(n, tile)
+    pad = n_tiles * tile - n
+
+    ds = jnp.pad(dataset, ((0, pad), (0, 0))) if pad else dataset
+    ds_tiles = ds.reshape(n_tiles, tile, d)
+    if norms is not None:
+        nm = jnp.pad(norms, (0, pad)) if pad else norms
+        nm_tiles = nm.reshape(n_tiles, tile)
+    else:
+        nm_tiles = jnp.zeros((n_tiles, tile), jnp.float32)
+    if has_filter:
+        fm = jnp.pad(filter_mask, (0, pad)) if pad else filter_mask
+        fm_tiles = fm.reshape(n_tiles, tile)
+    else:
+        fm_tiles = jnp.ones((n_tiles, tile), bool)
+
+    q_sqnorm = row_norms(queries) if metric in _NORM_METRICS else None
+    worst = jnp.float32(worst_value(jnp.float32, select_min))
+
+    init = (
+        jnp.full((qb, k), worst, jnp.float32),
+        jnp.full((qb, k), -1, jnp.int32),
+    )
+
+    def body(carry, inputs):
+        acc_v, acc_i = carry
+        t, yt, ynt, fmt = inputs
+        dist = _tile_distances(queries, q_sqnorm, yt, ynt, metric, p).astype(jnp.float32)
+        ids = t * tile + jnp.arange(tile, dtype=jnp.int32)
+        valid = (ids < n) & fmt
+        dist = jnp.where(valid[None, :], dist, worst)
+        tile_ids = jnp.broadcast_to(ids[None, :], dist.shape)
+        acc_v, acc_i = running_merge(acc_v, acc_i, dist, tile_ids, select_min=select_min)
+        return (acc_v, acc_i), None
+
+    (vals, idx), _ = lax.scan(
+        body, init, (jnp.arange(n_tiles, dtype=jnp.int32), ds_tiles, nm_tiles, fm_tiles)
+    )
+    # Rows knocked out by the filter keep id -1 and the worst sentinel,
+    # matching the reference's behavior of returning invalid ids when fewer
+    # than k candidates pass the filter.
+    return vals, idx
+
+
+def search(
+    index: BruteForceIndex,
+    queries,
+    k: int,
+    prefilter: Optional[Bitset] = None,
+    query_batch: int = 4096,
+    dataset_tile: Optional[int] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-nearest-neighbor search.
+
+    Analog of ``brute_force::search`` (``neighbors/brute_force-inl.cuh``).
+    Returns ``(distances [n_queries, k] f32, indices [n_queries, k] i32)``,
+    best-first. ``prefilter`` is a keep-bitset over dataset rows.
+    """
+    res = ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expects(queries.ndim == 2, "queries must be [n_queries, dim]")
+    expects(queries.shape[1] == index.dim, "query dim %d != index dim %d", queries.shape[1], index.dim)
+    n = index.size
+    expects(0 < k <= n, "k=%d out of range for index of size %d", k, n)
+    if prefilter is not None:
+        expects(prefilter.size == n, "prefilter size %d != index size %d", prefilter.size, n)
+
+    metric = index.metric
+    select_min = is_min_close(metric)
+    nq = queries.shape[0]
+
+    if dataset_tile is None:
+        # Size tiles so per-tile temporaries stay within the workspace budget
+        # (workspace heuristic analog of knn_brute_force.cuh:73-90
+        # faiss::chooseTileSize). Expanded metrics materialize [batch, tile];
+        # accumulation metrics broadcast [batch, tile, d] inside
+        # _tile_distances, so their budget divides by d as well.
+        qb = min(query_batch, nq)
+        per_elem = 8 if metric in _EXPANDED else 8 * index.dim
+        dataset_tile = max(512, min(n, res.workspace_bytes // (per_elem * max(qb, 1))))
+    dataset_tile = int(min(dataset_tile, n))
+
+    filter_mask = prefilter.to_mask() if prefilter is not None else None
+
+    out_v, out_i = [], []
+    for start in range(0, nq, query_batch):
+        qchunk = queries[start : start + query_batch]
+        # Pad the trailing batch so jit sees one batch shape (one compile).
+        bpad = 0
+        if qchunk.shape[0] < query_batch and nq > query_batch:
+            bpad = query_batch - qchunk.shape[0]
+            qchunk = jnp.pad(qchunk, ((0, bpad), (0, 0)))
+        v, i = _search_impl(
+            index.dataset,
+            index.norms,
+            qchunk,
+            filter_mask,
+            k=k,
+            metric=metric,
+            p=index.metric_arg,
+            tile=dataset_tile,
+            select_min=select_min,
+            has_filter=filter_mask is not None,
+        )
+        if bpad:
+            v, i = v[:-bpad], i[:-bpad]
+        out_v.append(v)
+        out_i.append(i)
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
+
+
+def knn(
+    dataset,
+    queries,
+    k: int,
+    metric=DistanceType.L2SqrtExpanded,
+    metric_arg: float = 2.0,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-shot build+search convenience (``brute_force::knn``,
+    ``neighbors/brute_force-inl.cuh:224``)."""
+    idx = build(dataset, metric=metric, metric_arg=metric_arg, res=res)
+    return search(idx, queries, k, res=res)
+
+
+# -- serialization ----------------------------------------------------------
+
+_KIND = "brute_force"
+_VERSION = 1
+
+
+def save(index: BruteForceIndex, stream: BinaryIO) -> None:
+    """Serialize (``neighbors/brute_force_serialize.cuh`` analog)."""
+    ser.dump_header(stream, _KIND, _VERSION)
+    ser.serialize_scalar(stream, int(index.metric), "int32")
+    ser.serialize_scalar(stream, float(index.metric_arg), "float64")
+    ser.serialize_scalar(stream, int(index.norms is not None), "int32")
+    ser.serialize_array(stream, index.dataset)
+    if index.norms is not None:
+        ser.serialize_array(stream, index.norms)
+
+
+def load(stream: BinaryIO, res: Optional[Resources] = None) -> BruteForceIndex:
+    ensure_resources(res)
+    ser.check_header(stream, _KIND)
+    metric = DistanceType(ser.deserialize_scalar(stream, "int32"))
+    metric_arg = float(ser.deserialize_scalar(stream, "float64"))
+    has_norms = bool(ser.deserialize_scalar(stream, "int32"))
+    dataset = ser.deserialize_array(stream)
+    norms = ser.deserialize_array(stream) if has_norms else None
+    return BruteForceIndex(dataset=dataset, norms=norms, metric=metric, metric_arg=metric_arg)

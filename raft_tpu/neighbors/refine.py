@@ -1,0 +1,73 @@
+"""Candidate re-ranking with exact distances — analog of
+``raft::neighbors::refine`` (``neighbors/refine-inl.cuh:70,92``).
+
+Given approximate candidate lists (e.g. from IVF-PQ or CAGRA), recompute
+exact distances between each query and its candidates and keep the best k.
+On TPU this is a batched gather + one small einsum per query block — XLA
+turns the [n_queries, n_candidates, dim] contraction into MXU work.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+from raft_tpu.neighbors.brute_force import _tile_distances, _NORM_METRICS
+from raft_tpu.ops.distance import DistanceType, is_min_close, resolve_metric, row_norms
+from raft_tpu.ops.select_k import select_k, worst_value
+
+
+def refine(
+    dataset,
+    queries,
+    candidates,
+    k: int,
+    metric=DistanceType.L2SqrtExpanded,
+    metric_arg: float = 2.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Re-rank ``candidates`` [n_queries, n_cand] (i32 ids into ``dataset``,
+    -1 = invalid) down to the top ``k`` by exact distance.
+
+    Returns ``(distances [n_queries, k], indices [n_queries, k])``.
+    """
+    metric = resolve_metric(metric)
+    dataset = jnp.asarray(dataset)
+    queries = jnp.asarray(queries)
+    candidates = jnp.asarray(candidates, jnp.int32)
+    expects(candidates.ndim == 2, "candidates must be [n_queries, n_candidates]")
+    expects(candidates.shape[0] == queries.shape[0], "queries/candidates row mismatch")
+    n_cand = candidates.shape[1]
+    expects(0 < k <= n_cand, "k=%d out of range for %d candidates", k, n_cand)
+
+    valid = candidates >= 0
+    safe_ids = jnp.where(valid, candidates, 0)
+    cand_vecs = dataset[safe_ids]  # [nq, n_cand, d]
+
+    qf = queries.astype(jnp.float32)
+    cf = cand_vecs.astype(jnp.float32)
+
+    select_min = is_min_close(metric)
+    worst = jnp.float32(worst_value(jnp.float32, select_min))
+
+    # Per-query exact distance to each candidate, via the same per-metric
+    # bodies as brute force (vmapped over the query axis).
+    q_sqnorm = row_norms(qf) if metric in _NORM_METRICS else None
+
+    def one_query(q, cands, qn):
+        qn_arr = None if qn is None else qn[None]
+        d = _tile_distances(q[None, :], qn_arr, cands, None if qn is None else row_norms(cands), metric, metric_arg)
+        return d[0]
+
+    if q_sqnorm is None:
+        dists = jax.vmap(lambda q, c: one_query(q, c, None))(qf, cf)
+    else:
+        dists = jax.vmap(lambda q, c, n: one_query(q, c, n))(qf, cf, q_sqnorm)
+
+    dists = jnp.where(valid, dists.astype(jnp.float32), worst)
+    vals, pos = select_k(dists, k, select_min=select_min)
+    idx = jnp.take_along_axis(candidates, pos, axis=1)
+    # Restore -1 for slots that selected an invalid (padded) candidate.
+    idx = jnp.where(jnp.take_along_axis(valid, pos, axis=1), idx, -1)
+    return vals, idx

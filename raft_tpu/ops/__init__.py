@@ -1,0 +1,29 @@
+"""Primitive ops layer (L4 analog): pairwise distance, top-k selection,
+fused distance+argmin.
+
+See ``SURVEY.md`` §2.3 for the reference component map
+(``/root/reference/cpp/include/raft/{distance,matrix}``).
+"""
+from raft_tpu.ops.distance import (
+    DistanceType,
+    is_min_close,
+    pairwise_distance,
+    resolve_metric,
+    row_norms,
+)
+from raft_tpu.ops.fused_1nn import fused_l2_nn, min_cluster_and_distance
+from raft_tpu.ops.select_k import merge_parts, running_merge, select_k, worst_value
+
+__all__ = [
+    "DistanceType",
+    "is_min_close",
+    "pairwise_distance",
+    "resolve_metric",
+    "row_norms",
+    "fused_l2_nn",
+    "min_cluster_and_distance",
+    "merge_parts",
+    "running_merge",
+    "select_k",
+    "worst_value",
+]

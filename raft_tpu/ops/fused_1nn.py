@@ -1,0 +1,168 @@
+"""Fused distance + argmin — TPU-native analog of ``fusedL2NN``.
+
+The reference fuses the 1-nearest-neighbor reduction into the distance
+kernel's epilogue so the full [m, n] distance matrix is never materialized
+(``distance/detail/fused_l2_nn.cuh:284`` ``fusedL2NNImpl``; public API
+``distance/fused_l2_nn.cuh``). That matters just as much on TPU — HBM
+bandwidth is the bottleneck — but the idiomatic formulation is different:
+tile the *centroid/candidate* axis with ``lax.scan``, compute each
+[m, tile] distance block as an MXU matmul, and fold a running
+``(min_val, argmin)`` carry. Peak memory is O(m * tile) and XLA fuses the
+min-reduction into the matmul epilogue.
+
+Also provides ``min_cluster_and_distance`` (the k-means EM inner step,
+``cluster/detail/kmeans.cuh:435`` ``minClusterAndDistanceCompute``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms
+from raft_tpu.utils.math import cdiv
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "sqrt"))
+def _fused_l2_nn_impl(x, y, x_sqnorm, y_sqnorm, *, tile: int, sqrt: bool):
+    m, d = x.shape
+    n = y.shape[0]
+    n_tiles = cdiv(n, tile)
+    n_pad = n_tiles * tile - n
+
+    yp = jnp.pad(y, ((0, n_pad), (0, 0))) if n_pad else y
+    ynp = jnp.pad(y_sqnorm, (0, n_pad), constant_values=jnp.inf) if n_pad else y_sqnorm
+    y_tiles = yp.reshape(n_tiles, tile, d)
+    yn_tiles = ynp.reshape(n_tiles, tile)
+
+    init = (
+        jnp.full((m,), jnp.inf, jnp.float32),
+        jnp.zeros((m,), jnp.int32),
+    )
+
+    def body(carry, inputs):
+        best_val, best_idx = carry
+        t, (yt, ynt) = inputs
+        dot = lax.dot_general(
+            x, yt, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        d2 = x_sqnorm[:, None] + ynt[None, :] - 2.0 * dot
+        d2 = jnp.maximum(d2, 0.0)
+        # Padded columns carry inf norms -> inf distance -> never selected.
+        d2 = jnp.where(ynt[None, :] == jnp.inf, jnp.inf, d2)
+        tile_val = jnp.min(d2, axis=1)
+        tile_arg = jnp.argmin(d2, axis=1).astype(jnp.int32) + t * tile
+        # Tie-break toward the lower index, matching the reference's
+        # KVPMinReduce (core/kvp.hpp) which keeps the first-seen minimum.
+        take_new = tile_val < best_val
+        best_val = jnp.where(take_new, tile_val, best_val)
+        best_idx = jnp.where(take_new, tile_arg, best_idx)
+        return (best_val, best_idx), None
+
+    (best_val, best_idx), _ = lax.scan(
+        body, init, (jnp.arange(n_tiles), (y_tiles, yn_tiles))
+    )
+    if sqrt:
+        best_val = jnp.sqrt(best_val)
+    return best_val, best_idx
+
+
+def fused_l2_nn(
+    x,
+    y,
+    x_sqnorm: Optional[jax.Array] = None,
+    y_sqnorm: Optional[jax.Array] = None,
+    sqrt: bool = False,
+    tile: int = 2048,
+) -> Tuple[jax.Array, jax.Array]:
+    """For each row of ``x`` [m, d], the (distance, index) of its nearest row
+    in ``y`` [n, d] under (squared) L2 — without materializing [m, n].
+
+    Analog of ``fusedL2NNMinReduce`` (``distance/fused_l2_nn.cuh:163``).
+    Returns ``(min_dist [m] f32, argmin [m] i32)``.
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    expects(x.ndim == 2 and y.ndim == 2, "fused_l2_nn expects 2-D inputs")
+    expects(x.shape[1] == y.shape[1], "feature dims differ")
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    xn = row_norms(xf) if x_sqnorm is None else x_sqnorm.astype(jnp.float32)
+    yn = row_norms(yf) if y_sqnorm is None else y_sqnorm.astype(jnp.float32)
+    tile = int(min(tile, max(128, y.shape[0])))
+    return _fused_l2_nn_impl(xf, yf, xn, yn, tile=tile, sqrt=sqrt)
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _fused_ip_nn_impl(x, y, *, tile: int):
+    """Max-inner-product 1-NN: same tiled scan as the L2 path but carrying a
+    running (max dot, argmax)."""
+    m, d = x.shape
+    n = y.shape[0]
+    n_tiles = cdiv(n, tile)
+    n_pad = n_tiles * tile - n
+    yp = jnp.pad(y, ((0, n_pad), (0, 0))) if n_pad else y
+    valid = jnp.arange(n_tiles * tile) < n
+    y_tiles = yp.reshape(n_tiles, tile, d)
+    v_tiles = valid.reshape(n_tiles, tile)
+
+    init = (jnp.full((m,), -jnp.inf, jnp.float32), jnp.zeros((m,), jnp.int32))
+
+    def body(carry, inputs):
+        best_val, best_idx = carry
+        t, (yt, vt) = inputs
+        dot = lax.dot_general(
+            x, yt, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dot = jnp.where(vt[None, :], dot, -jnp.inf)
+        tile_val = jnp.max(dot, axis=1)
+        tile_arg = jnp.argmax(dot, axis=1).astype(jnp.int32) + t * tile
+        take_new = tile_val > best_val
+        return (
+            jnp.where(take_new, tile_val, best_val),
+            jnp.where(take_new, tile_arg, best_idx),
+        ), None
+
+    (best_val, best_idx), _ = lax.scan(
+        body, init, (jnp.arange(n_tiles), (y_tiles, v_tiles))
+    )
+    return best_val, best_idx
+
+
+def min_cluster_and_distance(
+    x,
+    centroids,
+    metric=DistanceType.L2Expanded,
+    tile: int = 2048,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-sample nearest centroid (labels) + distance — the k-means EM inner
+    step (``cluster/detail/kmeans.cuh:435``).
+
+    * L2 variants: the fused L2 scan directly.
+    * Cosine: rows are L2-normalized first — nearest-cosine == nearest-L2 on
+      the unit sphere (1 - cos = ||x̂-ŷ||²/2), as the balanced-kmeans
+      reference does (``cluster/detail/kmeans_balanced.cuh:83``
+      predict_core) — and the distance is rescaled to 1 - cos so it matches
+      :func:`pairwise_distance`'s cosine values.
+    * InnerProduct: true max-inner-product (no normalization; centroid
+      magnitude matters); returned "distance" is the raw dot product.
+    """
+    metric = resolve_metric(metric)
+    x = jnp.asarray(x, jnp.float32)
+    c = jnp.asarray(centroids, jnp.float32)
+    tile_c = int(min(tile, max(128, c.shape[0])))
+    if metric == DistanceType.InnerProduct:
+        dot, idx = _fused_ip_nn_impl(x, c, tile=tile_c)
+        return idx, dot
+    if metric == DistanceType.CosineExpanded:
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+        cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
+        d2, idx = fused_l2_nn(xn, cn, tile=tile_c)
+        return idx, 0.5 * d2  # ||x̂-ĉ||²/2 == 1 - cos
+    sqrt = metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
+    dist, idx = fused_l2_nn(x, c, sqrt=sqrt, tile=tile_c)
+    return idx, dist

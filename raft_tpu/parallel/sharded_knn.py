@@ -1,0 +1,90 @@
+"""ICI-sharded exact kNN: dataset rows sharded over a mesh axis, local
+top-k per shard, ``all_gather`` + k-way merge.
+
+The reference keeps multi-GPU ANN consumers downstream (cuML/cuGraph) and
+ships only the comms layer (SURVEY.md §2.5); per the TPU-first design this
+framework makes sharded search in-tree. The merge step is the
+``knn_merge_parts`` pattern (``neighbors/detail/knn_merge_parts.cuh``)
+applied across shards instead of streams.
+
+Works on any 1-axis mesh (real TPU ICI or the 8-device CPU test mesh).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.core.errors import expects
+from raft_tpu.neighbors.brute_force import _NORM_METRICS, _search_impl
+from raft_tpu.ops import distance as _dist
+from raft_tpu.ops.distance import DistanceType, is_min_close, resolve_metric
+from raft_tpu.ops.select_k import merge_parts
+
+
+def sharded_knn(
+    mesh: Mesh,
+    dataset,
+    queries,
+    k: int,
+    metric=DistanceType.L2SqrtExpanded,
+    metric_arg: float = 2.0,
+    axis: str = "data",
+    dataset_tile: int = 2048,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN with the dataset row-sharded across ``mesh`` axis ``axis``.
+
+    ``dataset`` [n, d] is split into equal row blocks per device (n must be
+    divisible by the axis size — pad upstream if needed); ``queries`` are
+    replicated. Each shard computes a local top-k with *global* ids, results
+    are all-gathered and merged. Returns replicated
+    ``(distances [nq, k], indices [nq, k])`` identical to unsharded search.
+    """
+    metric = resolve_metric(metric)
+    dataset = jnp.asarray(dataset)
+    queries = jnp.asarray(queries)
+    n, d = dataset.shape
+    n_shards = mesh.shape[axis]
+    expects(n % n_shards == 0, "dataset rows %d not divisible by %d shards", n, n_shards)
+    per = n // n_shards
+    expects(k <= per, "k=%d larger than per-shard rows %d", k, per)
+    select_min = is_min_close(metric)
+
+    def local_search(ds_local, q):
+        rank = jax.lax.axis_index(axis)
+        vals, idx = _search_impl(
+            ds_local,
+            _dist.row_norms(ds_local) if metric in _NORM_METRICS else None,
+            q,
+            None,
+            k=k,
+            metric=metric,
+            p=metric_arg,
+            tile=min(dataset_tile, per),
+            select_min=select_min,
+            has_filter=False,
+        )
+        idx = jnp.where(idx >= 0, idx + rank * per, idx)
+        # Gather each shard's [nq, k] block -> [n_shards, nq, k], flatten the
+        # part axis into the candidate axis and merge (knn_merge_parts).
+        all_vals = jax.lax.all_gather(vals, axis)
+        all_idx = jax.lax.all_gather(idx, axis)
+        nq = q.shape[0]
+        cat_vals = jnp.moveaxis(all_vals, 0, 1).reshape(nq, -1)
+        cat_idx = jnp.moveaxis(all_idx, 0, 1).reshape(nq, -1)
+        return merge_parts(cat_vals, cat_idx, k, select_min=select_min)
+
+    fn = shard_map(
+        local_search,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    ds_sharded = jax.device_put(dataset, NamedSharding(mesh, P(axis, None)))
+    q_repl = jax.device_put(queries, NamedSharding(mesh, P(None, None)))
+    return jax.jit(fn)(ds_sharded, q_repl)

@@ -1,0 +1,7 @@
+"""Statistics layer (L4 analog): summary stats + model/ANN metrics.
+
+See ``SURVEY.md`` §2.3 (``/root/reference/cpp/include/raft/stats``).
+"""
+from raft_tpu.stats.recall import neighborhood_recall
+
+__all__ = ["neighborhood_recall"]

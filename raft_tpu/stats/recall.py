@@ -1,0 +1,42 @@
+"""ANN recall metric — analog of ``raft::stats::neighborhood_recall``
+(``stats/neighborhood_recall.cuh:35-62``).
+
+Recall = fraction of (query, rank) pairs whose returned index appears in the
+query's ground-truth top-k (order-insensitive), optionally also accepting
+distance ties within ``eps`` (the reference's distance-match fallback for
+equal-distance neighbors).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def neighborhood_recall(
+    indices,
+    ref_indices,
+    distances: Optional[jax.Array] = None,
+    ref_distances: Optional[jax.Array] = None,
+    eps: float = 1e-3,
+) -> jax.Array:
+    """Compute recall of ``indices`` [n_queries, k] against ``ref_indices``.
+
+    When distances are supplied, a non-matching id still counts if its
+    distance matches any ground-truth distance within ``eps`` (handles
+    equal-distance permutations, mirroring the reference's check).
+    Returns a scalar f32 in [0, 1].
+    """
+    indices = jnp.asarray(indices)
+    ref_indices = jnp.asarray(ref_indices)
+    assert indices.shape == ref_indices.shape, "indices/ref shape mismatch"
+    id_match = (indices[:, :, None] == ref_indices[:, None, :]).any(axis=2)
+    if distances is not None and ref_distances is not None:
+        distances = jnp.asarray(distances)
+        ref_distances = jnp.asarray(ref_distances)
+        dist_match = (
+            jnp.abs(distances[:, :, None] - ref_distances[:, None, :]) < eps
+        ).any(axis=2)
+        id_match = id_match | dist_match
+    return jnp.mean(id_match.astype(jnp.float32))

@@ -1,0 +1,198 @@
+"""Brute-force kNN end-to-end tests: recall vs exact numpy kNN across
+dtypes and metrics, prefilters, serialization round-trip, refine.
+
+Mirrors the reference ANN test pattern (``cpp/test/neighbors/ann_utils.cuh``
+``eval_neighbours`` recall-threshold checks vs a naive exact reference).
+"""
+import io
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as spd
+
+import jax.numpy as jnp
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors.refine import refine
+from raft_tpu.ops import DistanceType
+from raft_tpu.stats import neighborhood_recall
+
+N, D, NQ, K = 2000, 32, 64, 10
+
+
+@pytest.fixture
+def data(rng):
+    dataset = rng.standard_normal((N, D), dtype=np.float32)
+    queries = rng.standard_normal((NQ, D), dtype=np.float32)
+    return dataset, queries
+
+
+def exact_knn(dataset, queries, k, scipy_metric="euclidean", largest=False):
+    d = spd.cdist(queries.astype(np.float64), dataset.astype(np.float64), scipy_metric)
+    if largest:
+        d = -d
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+@pytest.mark.parametrize(
+    "metric,scipy_metric",
+    [
+        (DistanceType.L2SqrtExpanded, "euclidean"),
+        (DistanceType.L2Expanded, "sqeuclidean"),
+        (DistanceType.CosineExpanded, "cosine"),
+        (DistanceType.L1, "cityblock"),
+    ],
+)
+def test_search_recall(data, metric, scipy_metric):
+    dataset, queries = data
+    index = brute_force.build(dataset, metric=metric)
+    dist, idx = brute_force.search(index, queries, K)
+    _, ref_idx = exact_knn(dataset, queries, K, scipy_metric)
+    recall = float(neighborhood_recall(np.asarray(idx), ref_idx))
+    assert recall >= 0.99, f"recall {recall} too low for {metric}"
+
+
+def test_inner_product_select_max(data):
+    dataset, queries = data
+    index = brute_force.build(dataset, metric=DistanceType.InnerProduct)
+    dist, idx = brute_force.search(index, queries, K)
+    sims = queries @ dataset.T
+    ref_idx = np.argsort(-sims, axis=1)[:, :K]
+    recall = float(neighborhood_recall(np.asarray(idx), ref_idx))
+    assert recall >= 0.99
+    # distances must be descending (best-first for a similarity)
+    dv = np.asarray(dist)
+    assert (np.diff(dv, axis=1) <= 1e-5).all()
+
+
+def test_exact_values(data):
+    dataset, queries = data
+    index = brute_force.build(dataset, metric=DistanceType.L2SqrtExpanded)
+    dist, idx = brute_force.search(index, queries, K)
+    ref_dist, _ = exact_knn(dataset, queries, K, "euclidean")
+    np.testing.assert_allclose(np.asarray(dist), ref_dist, rtol=1e-3, atol=1e-3)
+
+
+def test_tiled_matches_untiled(data):
+    dataset, queries = data
+    index = brute_force.build(dataset, metric=DistanceType.L2SqrtExpanded)
+    d1, i1 = brute_force.search(index, queries, K, dataset_tile=N)
+    d2, i2 = brute_force.search(index, queries, K, dataset_tile=300)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5, atol=1e-5)
+
+
+def test_query_batching(data):
+    dataset, queries = data
+    index = brute_force.build(dataset)
+    d1, i1 = brute_force.search(index, queries, K, query_batch=17)
+    d2, i2 = brute_force.search(index, queries, K, query_batch=NQ)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16, np.int8, np.uint8])
+def test_dtypes(rng, dtype):
+    if dtype in (np.int8,):
+        dataset = rng.integers(-30, 30, (500, 16)).astype(np.int8)
+        queries = rng.integers(-30, 30, (20, 16)).astype(np.int8)
+    elif dtype in (np.uint8,):
+        dataset = rng.integers(0, 60, (500, 16)).astype(np.uint8)
+        queries = rng.integers(0, 60, (20, 16)).astype(np.uint8)
+    else:
+        dataset = jnp.asarray(rng.standard_normal((500, 16), dtype=np.float32), dtype)
+        queries = jnp.asarray(rng.standard_normal((20, 16), dtype=np.float32), dtype)
+    index = brute_force.build(dataset, metric=DistanceType.L2Expanded)
+    dist, idx = brute_force.search(index, queries, 5)
+    ref_d = spd.cdist(
+        np.asarray(dataset, np.float64), np.asarray(queries, np.float64).reshape(20, 16) * 1.0, "sqeuclidean"
+    ).T if False else spd.cdist(np.asarray(queries, np.float64), np.asarray(dataset, np.float64), "sqeuclidean")
+    ref_idx = np.argsort(ref_d, axis=1)[:, :5]
+    recall = float(neighborhood_recall(np.asarray(idx), ref_idx,
+                                       np.asarray(dist, np.float32),
+                                       np.take_along_axis(ref_d, ref_idx, axis=1).astype(np.float32),
+                                       eps=0.5 if dtype == jnp.bfloat16 else 1e-2))
+    assert recall >= 0.99, f"recall {recall} for {dtype}"
+
+
+def test_prefilter(data):
+    dataset, queries = data
+    index = brute_force.build(dataset, metric=DistanceType.L2Expanded)
+    # Remove the unfiltered top-1 of every query; it must not reappear.
+    _, base_idx = brute_force.search(index, queries, 1)
+    banned = np.unique(np.asarray(base_idx).ravel())
+    keep = np.ones(N, bool)
+    keep[banned] = False
+    bs = Bitset.from_mask(jnp.asarray(keep))
+    _, idx = brute_force.search(index, queries, K, prefilter=bs)
+    assert not np.isin(np.asarray(idx), banned).any()
+    # And results must equal exact search over the kept subset.
+    sub = np.where(keep)[0]
+    ref_d = spd.cdist(queries, dataset[sub], "sqeuclidean")
+    ref_idx = sub[np.argsort(ref_d, axis=1)[:, :K]]
+    recall = float(neighborhood_recall(np.asarray(idx), ref_idx))
+    assert recall >= 0.99
+
+
+def test_filter_all_but_few(data):
+    dataset, queries = data
+    keep = np.zeros(N, bool)
+    keep[:5] = True  # fewer than K survivors
+    index = brute_force.build(dataset)
+    dist, idx = brute_force.search(index, queries, K, prefilter=Bitset.from_mask(jnp.asarray(keep)))
+    idx = np.asarray(idx)
+    assert (np.sort(np.unique(idx)) == np.array([-1, 0, 1, 2, 3, 4])).all()
+    # exactly 5 valid entries per row
+    assert ((idx >= 0).sum(axis=1) == 5).all()
+
+
+def test_serialize_roundtrip(data):
+    dataset, queries = data
+    index = brute_force.build(dataset, metric=DistanceType.CosineExpanded)
+    buf = io.BytesIO()
+    brute_force.save(index, buf)
+    buf.seek(0)
+    loaded = brute_force.load(buf)
+    assert loaded.metric == index.metric
+    d1, i1 = brute_force.search(index, queries, K)
+    d2, i2 = brute_force.search(loaded, queries, K)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_knn_convenience(data):
+    dataset, queries = data
+    dist, idx = brute_force.knn(dataset, queries, K)
+    _, ref_idx = exact_knn(dataset, queries, K)
+    assert float(neighborhood_recall(np.asarray(idx), ref_idx)) >= 0.99
+
+
+def test_refine(data):
+    dataset, queries = data
+    # Candidates: exact top-30 ids shuffled + some noise; refine to top-10
+    # must recover the exact top-10.
+    _, cand = exact_knn(dataset, queries, 30)
+    perm = np.random.default_rng(0).permutation(30)
+    cand = cand[:, perm].astype(np.int32)
+    dist, idx = refine(dataset, queries, cand, K, metric=DistanceType.L2SqrtExpanded)
+    ref_dist, ref_idx = exact_knn(dataset, queries, K)
+    assert float(neighborhood_recall(np.asarray(idx), ref_idx)) >= 0.999
+    np.testing.assert_allclose(np.asarray(dist), ref_dist, rtol=1e-3, atol=1e-3)
+
+
+def test_refine_invalid_candidates(data):
+    dataset, queries = data
+    _, cand = exact_knn(dataset, queries, 15)
+    cand = cand.astype(np.int32)
+    cand[:, 10:] = -1  # only 15-5=10 valid
+    dist, idx = refine(dataset, queries, cand, 12)
+    idx = np.asarray(idx)
+    assert ((idx >= 0).sum(axis=1) == 10).all()
+    assert (idx[:, 10:] == -1).all()
+
+
+def test_recall_metric_itself():
+    idx = np.array([[0, 1, 2], [3, 4, 5]])
+    ref = np.array([[2, 1, 9], [3, 4, 5]])
+    r = float(neighborhood_recall(idx, ref))
+    np.testing.assert_allclose(r, (2 + 3) / 6)
