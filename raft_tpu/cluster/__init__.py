@@ -1,0 +1,16 @@
+"""Clustering layer (L5 analog): k-means (Lloyd + ++), balanced hierarchical
+k-means, single-linkage.
+
+See ``SURVEY.md`` §2.4 (``/root/reference/cpp/include/raft/cluster``).
+"""
+from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster.kmeans import KMeansOutput, KMeansParams
+from raft_tpu.cluster.kmeans_balanced import BalancedKMeansParams
+
+__all__ = [
+    "kmeans",
+    "kmeans_balanced",
+    "KMeansOutput",
+    "KMeansParams",
+    "BalancedKMeansParams",
+]

@@ -1,0 +1,190 @@
+"""K-means (Lloyd) with k-means++ init — analog of ``raft::cluster::kmeans``.
+
+Reference: ``cluster/kmeans.cuh:89`` (``kmeans::fit``), params struct
+``cluster/kmeans_types.hpp:38-70``, EM loop ``cluster/detail/kmeans.cuh:362``
+(``kmeans_fit_main``), ``kmeansPlusPlus`` (``:91``), ``update_centroids``
+(``:288``).
+
+TPU design notes:
+
+* The EM loop runs entirely on-device in ``lax.while_loop`` — the reference
+  pays a device→host sync per iteration for its convergence check
+  (``kmeans.cuh:440-455``); here the inertia/shift test is part of the loop
+  carry, so there is no per-iteration ping-pong.
+* The E step is the fused distance+argmin scan
+  (:func:`raft_tpu.ops.fused_1nn.min_cluster_and_distance`) — [n, k]
+  distances are never materialized.
+* The M step is a ``segment_sum`` (XLA scatter-add), the
+  ``reduce_rows_by_key`` analog.
+* k-means++ seeding draws one center per ``fori_loop`` step via the
+  categorical-from-min-distance trick, all on-device.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import DistanceType, resolve_metric
+from raft_tpu.ops.fused_1nn import min_cluster_and_distance
+from raft_tpu.random.rng import as_key
+
+
+@dataclasses.dataclass
+class KMeansParams:
+    """``cluster/kmeans_types.hpp:38-70`` analog."""
+
+    n_clusters: int = 8
+    max_iter: int = 300
+    tol: float = 1e-4
+    init: str = "kmeans++"  # "kmeans++" | "random" | "array"
+    n_init: int = 1
+    metric: DistanceType = DistanceType.L2Expanded
+    seed: int = 0
+    oversampling_factor: float = 2.0  # kept for param parity; unused by Lloyd
+    batch_samples: int = 1 << 15
+
+
+@dataclasses.dataclass
+class KMeansOutput:
+    centroids: jax.Array  # [k, d] f32
+    labels: jax.Array  # [n] i32
+    inertia: jax.Array  # scalar f32
+    n_iter: jax.Array  # scalar i32
+
+
+def kmeans_plus_plus(key, X: jax.Array, k: int) -> jax.Array:
+    """k-means++ seeding (``cluster/detail/kmeans.cuh:91`` kmeansPlusPlus):
+    first center uniform, then each next center sampled with probability
+    proportional to squared distance to the nearest chosen center."""
+    n, d = X.shape
+    k0, kloop = jax.random.split(key)
+    first = jax.random.randint(k0, (), 0, n)
+    centers = jnp.zeros((k, d), jnp.float32).at[0].set(X[first])
+    min_d2 = jnp.sum((X - X[first]) ** 2, axis=1)
+
+    def body(i, carry):
+        centers, min_d2, kk = carry
+        kk, ksel = jax.random.split(kk)
+        # Sample proportional to min_d2 (log-categorical; zero-safe).
+        logits = jnp.log(jnp.maximum(min_d2, 1e-30))
+        idx = jax.random.categorical(ksel, logits)
+        c = X[idx]
+        centers = centers.at[i].set(c)
+        min_d2 = jnp.minimum(min_d2, jnp.sum((X - c) ** 2, axis=1))
+        return centers, min_d2, kk
+
+    centers, _, _ = lax.fori_loop(1, k, body, (centers, min_d2, kloop))
+    return centers
+
+
+def _update_centroids(X, labels, k: int, old_centroids):
+    """M step (``cluster/detail/kmeans.cuh:288`` update_centroids): mean of
+    assigned points; empty clusters keep their previous centroid (the
+    reference copies the old center for weight-0 clusters)."""
+    sums = jax.ops.segment_sum(X, labels, num_segments=k)
+    counts = jax.ops.segment_sum(jnp.ones((X.shape[0],), jnp.float32), labels, num_segments=k)
+    means = sums / jnp.maximum(counts[:, None], 1.0)
+    return jnp.where(counts[:, None] > 0, means, old_centroids), counts
+
+
+def fit(
+    X,
+    params: Optional[KMeansParams] = None,
+    centroids: Optional[jax.Array] = None,
+    sample_weights: Optional[jax.Array] = None,
+    res: Optional[Resources] = None,
+    **kwargs,
+) -> KMeansOutput:
+    """Lloyd EM (``kmeans::fit``, ``cluster/kmeans.cuh:89``).
+
+    ``kwargs`` are convenience overrides for :class:`KMeansParams` fields
+    (e.g. ``fit(X, n_clusters=16)``).
+    """
+    res = ensure_resources(res)
+    if params is None:
+        params = KMeansParams(**kwargs)
+    metric = resolve_metric(params.metric)
+    X = jnp.asarray(X, jnp.float32)
+    expects(X.ndim == 2, "X must be [n_samples, n_features]")
+    n, d = X.shape
+    k = params.n_clusters
+    expects(0 < k <= n, "n_clusters=%d out of range for %d samples", k, n)
+
+    key = as_key(params.seed)
+    best = None
+    for trial in range(max(1, params.n_init)):
+        key, kinit = jax.random.split(key)
+        if centroids is not None:
+            init_centers = jnp.asarray(centroids, jnp.float32)
+            expects(init_centers.shape == (k, d), "explicit centroids shape mismatch")
+        elif params.init == "random":
+            idx = jax.random.permutation(kinit, n)[:k]
+            init_centers = X[idx]
+        else:
+            init_centers = kmeans_plus_plus(kinit, X, k)
+
+        out = _lloyd(X, init_centers, k, metric, params.max_iter, params.tol)
+        if best is None or float(out.inertia) < float(best.inertia):
+            best = out
+        if centroids is not None:
+            break
+    return best
+
+
+def _lloyd(X, init_centers, k: int, metric, max_iter: int, tol: float) -> KMeansOutput:
+    n = X.shape[0]
+    tol2 = jnp.float32(tol * tol)
+
+    def cond(carry):
+        _, _, it, shift2, _ = carry
+        return (it < max_iter) & (shift2 > tol2)
+
+    def body(carry):
+        centers, _, it, _, _ = carry
+        labels, dists = min_cluster_and_distance(X, centers, metric=metric)
+        new_centers, _ = _update_centroids(X, labels, k, centers)
+        shift2 = jnp.sum((new_centers - centers) ** 2)
+        inertia = jnp.sum(dists)
+        return new_centers, labels, it + 1, shift2, inertia
+
+    init = (
+        init_centers,
+        jnp.zeros((n,), jnp.int32),
+        jnp.int32(0),
+        jnp.float32(jnp.inf),
+        jnp.float32(jnp.inf),
+    )
+    centers, labels, n_iter, _, _ = lax.while_loop(cond, body, init)
+    # Final E step so labels/inertia match the returned centroids.
+    labels, dists = min_cluster_and_distance(X, centers, metric=metric)
+    return KMeansOutput(centroids=centers, labels=labels, inertia=jnp.sum(dists), n_iter=n_iter)
+
+
+def predict(X, centroids, metric=DistanceType.L2Expanded) -> Tuple[jax.Array, jax.Array]:
+    """Assign samples to nearest centroids (``kmeans::predict``). Returns
+    (labels, distances)."""
+    labels, dists = min_cluster_and_distance(jnp.asarray(X, jnp.float32), centroids, metric=metric)
+    return labels, dists
+
+
+def fit_predict(X, params: Optional[KMeansParams] = None, **kwargs) -> Tuple[KMeansOutput, jax.Array]:
+    out = fit(X, params, **kwargs)
+    return out, out.labels
+
+
+def transform(X, centroids, metric=DistanceType.L2Expanded) -> jax.Array:
+    """Distances to every centroid (``kmeans::transform``) — [n, k]."""
+    from raft_tpu.ops.distance import pairwise_distance
+
+    return pairwise_distance(jnp.asarray(X, jnp.float32), centroids, metric=metric)
+
+
+def inertia(X, centroids, metric=DistanceType.L2Expanded) -> jax.Array:
+    _, dists = predict(X, centroids, metric)
+    return jnp.sum(dists)

@@ -1,0 +1,207 @@
+"""Hierarchical balanced k-means — analog of ``raft::cluster::kmeans_balanced``.
+
+This is the trainer behind every IVF index: it must produce ``k`` centroids
+whose cluster populations are *balanced* (no giant or empty inverted lists).
+Reference: ``cluster/kmeans_balanced.cuh:77`` (``fit``),
+``cluster/detail/kmeans_balanced.cuh:952`` (``build_hierarchical``),
+``:839`` (``build_fine_clusters``), ``:615`` (``balancing_em_iters``),
+``:98`` (``adjust_centers``).
+
+TPU design: the same three phases as the reference —
+
+1. **Mesocluster pass**: plain Lloyd with ``≈√k`` mesoclusters on a
+   trainset subsample.
+2. **Fine clusters**: per mesocluster, a *weighted* Lloyd run (all points
+   participate with 0/1 weights — static shapes, no ragged partitions) with
+   a proportional share of ``k``.
+3. **Balancing EM**: full-data EM iterations where, after each assignment,
+   under-populated clusters (count < avg/ratio) are re-seeded onto data
+   points drawn from crowded clusters (``adjust_centers``), pulling list
+   sizes toward the mean.
+
+The mesocluster size bookkeeping runs on host (build-time only, matching the
+reference's host-side loop at ``kmeans_balanced.cuh:988-1028``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import DistanceType, resolve_metric
+from raft_tpu.ops.fused_1nn import min_cluster_and_distance
+from raft_tpu.random.rng import as_key
+
+# Reference constant kAdjustCentersWeight (kmeans_balanced.cuh:78).
+_ADJUST_WEIGHT = 7.0
+
+
+@dataclasses.dataclass
+class BalancedKMeansParams:
+    """``kmeans_balanced_params`` analog (``cluster/kmeans_types.hpp:80``)."""
+
+    n_clusters: int = 8
+    n_iters: int = 20  # balancing EM iterations
+    metric: DistanceType = DistanceType.L2Expanded
+    seed: int = 0
+    max_train_points_per_cluster: int = 256  # trainset subsample budget
+    balancing_threshold: float = 0.25  # re-seed clusters below avg*threshold
+
+
+def _weighted_lloyd(X, weights, init_centers, k: int, metric, n_iters: int):
+    """Lloyd restricted to ``weights``-selected points (0/1 weights keep all
+    shapes static — the TPU alternative to the reference's gather into a
+    per-mesocluster buffer at ``build_fine_clusters``)."""
+
+    def body(_, centers):
+        labels, _ = min_cluster_and_distance(X, centers, metric=metric)
+        w = weights
+        sums = jax.ops.segment_sum(X * w[:, None], labels, num_segments=k)
+        counts = jax.ops.segment_sum(w, labels, num_segments=k)
+        means = sums / jnp.maximum(counts[:, None], 1e-9)
+        return jnp.where(counts[:, None] > 0, means, centers)
+
+    return lax.fori_loop(0, n_iters, body, init_centers)
+
+
+def _adjust_centers(key, X, centers, counts, threshold: float):
+    """Re-seed under-populated clusters onto random data points, biased
+    toward points in crowded clusters (``adjust_centers``,
+    ``kmeans_balanced.cuh:98-180``)."""
+    k = centers.shape[0]
+    n = X.shape[0]
+    avg = n / k
+    small = counts < (avg * threshold)
+    # One candidate point per cluster, drawn uniformly; the average-weighted
+    # blend (W = 7) matches the reference's smoothing so a re-seeded center
+    # keeps some memory of its old position.
+    idx = jax.random.randint(key, (k,), 0, n)
+    candidates = X[idx]
+    w = _ADJUST_WEIGHT
+    blended = (centers * 1.0 + candidates * w) / (1.0 + w)
+    return jnp.where(small[:, None], blended, centers), small.sum()
+
+
+def _em_iters(key, X, centers, k: int, metric, n_iters: int, threshold: float):
+    """Balancing EM (``balancing_em_iters``, ``kmeans_balanced.cuh:615``):
+    assignment + mean update + center adjustment, fully on-device."""
+
+    def body(i, carry):
+        centers, kk = carry
+        kk, kadj = jax.random.split(kk)
+        labels, _ = min_cluster_and_distance(X, centers, metric=metric)
+        sums = jax.ops.segment_sum(X, labels, num_segments=k)
+        counts = jax.ops.segment_sum(jnp.ones((X.shape[0],), jnp.float32), labels, num_segments=k)
+        means = sums / jnp.maximum(counts[:, None], 1.0)
+        centers = jnp.where(counts[:, None] > 0, means, centers)
+        centers, _ = _adjust_centers(kadj, X, centers, counts, threshold)
+        return centers, kk
+
+    centers, _ = lax.fori_loop(0, n_iters, body, (centers, key))
+    # Final pure-mean pass (no adjustment) so returned centers are the means
+    # of their final assignments.
+    labels, _ = min_cluster_and_distance(X, centers, metric=metric)
+    sums = jax.ops.segment_sum(X, labels, num_segments=k)
+    counts = jax.ops.segment_sum(jnp.ones((X.shape[0],), jnp.float32), labels, num_segments=k)
+    means = sums / jnp.maximum(counts[:, None], 1.0)
+    return jnp.where(counts[:, None] > 0, means, centers)
+
+
+def fit(
+    X,
+    params: Optional[BalancedKMeansParams] = None,
+    res: Optional[Resources] = None,
+    **kwargs,
+) -> jax.Array:
+    """Train balanced cluster centers; returns ``centroids [k, d] f32``.
+
+    Mirrors ``kmeans_balanced::fit`` → ``build_hierarchical``
+    (``kmeans_balanced.cuh:952``).
+    """
+    res = ensure_resources(res)
+    if params is None:
+        params = BalancedKMeansParams(**kwargs)
+    metric = resolve_metric(params.metric)
+    X = jnp.asarray(X, jnp.float32)
+    expects(X.ndim == 2, "X must be 2-D")
+    n, d = X.shape
+    k = params.n_clusters
+    expects(0 < k <= n, "n_clusters=%d out of range for n=%d", k, n)
+
+    key = as_key(params.seed)
+    k_sub, k_meso, k_fine, k_em = jax.random.split(key, 4)
+
+    # -- trainset subsample (build_hierarchical's trainset fraction) --------
+    max_train = min(n, k * params.max_train_points_per_cluster)
+    if max_train < n:
+        sub_idx = jax.random.permutation(k_sub, n)[:max_train]
+        Xt = X[sub_idx]
+    else:
+        Xt = X
+    nt = Xt.shape[0]
+
+    # -- phase 1: mesoclusters ---------------------------------------------
+    n_meso = int(min(max(1, round(math.sqrt(k))), k))
+    if n_meso <= 1 or k <= 8:
+        # Small k: single-level balanced EM with k-means++ seeding (random
+        # seeding merges natural clusters too often at tiny k).
+        from raft_tpu.cluster.kmeans import kmeans_plus_plus
+
+        init = kmeans_plus_plus(k_meso, Xt, k)
+        centers = _em_iters(k_em, X, init, k, metric, params.n_iters, params.balancing_threshold)
+        return centers
+
+    from raft_tpu.cluster.kmeans import KMeansParams, fit as kmeans_fit
+
+    meso = kmeans_fit(
+        Xt,
+        KMeansParams(n_clusters=n_meso, max_iter=20, metric=params.metric, seed=params.seed, init="random"),
+    )
+    meso_labels, _ = min_cluster_and_distance(Xt, meso.centroids, metric=metric)
+
+    # -- phase 2: proportional fine clusters (host-side allocation) ---------
+    counts = np.asarray(jax.ops.segment_sum(jnp.ones((nt,), jnp.float32), meso_labels, num_segments=n_meso))
+    # Allocate k across mesoclusters proportionally to population
+    # (build_fine_clusters' mesocluster_size_max bookkeeping).
+    raw = counts / max(counts.sum(), 1.0) * k
+    alloc = np.maximum(np.floor(raw).astype(int), 1)
+    while alloc.sum() > k:
+        alloc[np.argmax(alloc)] -= 1
+    while alloc.sum() < k:
+        alloc[np.argmax(raw - alloc)] += 1
+
+    fine_centers = []
+    w_all = jax.nn.one_hot(meso_labels, n_meso, dtype=jnp.float32)  # [nt, n_meso]
+    for m in range(n_meso):
+        km = int(alloc[m])
+        kf, k_fine = jax.random.split(k_fine)
+        weights = w_all[:, m]
+        # Seed from points in this mesocluster: weighted sample via gumbel.
+        g = jax.random.gumbel(kf, (nt,))
+        seed_idx = lax.top_k(jnp.log(jnp.maximum(weights, 1e-30)) + g, km)[1]
+        init = Xt[seed_idx]
+        fine_centers.append(_weighted_lloyd(Xt, weights, init, km, metric, 8))
+    centers = jnp.concatenate(fine_centers, axis=0)
+
+    # -- phase 3: balancing EM over the full dataset ------------------------
+    centers = _em_iters(k_em, X, centers, k, metric, params.n_iters, params.balancing_threshold)
+    return centers
+
+
+def predict(X, centroids, metric=DistanceType.L2Expanded) -> Tuple[jax.Array, jax.Array]:
+    """Nearest-centroid assignment (``kmeans_balanced::predict``)."""
+    return min_cluster_and_distance(jnp.asarray(X, jnp.float32), centroids, metric=metric)
+
+
+def fit_predict(X, params: Optional[BalancedKMeansParams] = None, **kwargs):
+    centers = fit(X, params, **kwargs)
+    metric = params.metric if params is not None else kwargs.get("metric", DistanceType.L2Expanded)
+    labels, _ = predict(X, centers, metric=metric)
+    return centers, labels

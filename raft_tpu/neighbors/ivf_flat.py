@@ -1,0 +1,416 @@
+"""IVF-Flat index — analog of ``raft::neighbors::ivf_flat``.
+
+Reference: index layout ``neighbors/ivf_flat_types.hpp:44-164`` (per-list
+interleaved groups of 32 rows x veclen chunks), build
+``neighbors/detail/ivf_flat_build.cuh:382-460``, search
+``neighbors/detail/ivf_flat_search-inl.cuh:271`` (coarse select at ``:145``),
+fused scan+top-k kernel ``detail/ivf_flat_interleaved_scan-inl.cuh:687``.
+
+TPU-first redesign (SURVEY.md §7 hard part (b) — ragged lists vs dense
+tiles):
+
+* Lists live in ONE dense padded tensor ``list_data [n_lists, max_list, d]``
+  with parallel ``list_indices [n_lists, max_list]`` (-1 pads) and
+  ``list_sizes [n_lists]`` — the CUDA 32-row interleave is replaced by
+  sublane-padded dense tiles XLA can tile onto the MXU/VPU directly, and the
+  gather of a probed list is one dynamic-slice.
+* Coarse quantization = pairwise distance to centers + select_k, exactly the
+  reference's ``select_clusters`` structure.
+* Fine search ``lax.scan``s over the ``n_probes`` axis: each step gathers
+  one probed list per query, computes the [batch, max_list] distance block
+  (dot via einsum on the MXU; norms pre-stored), masks padded slots /
+  filtered ids, and folds a running top-k — the interleaved_scan + fused
+  top-k kernel expressed as scan + merge.
+* Balanced k-means training keeps ``max_list`` close to the mean list size,
+  bounding the padding waste the dense layout costs.
+
+Supported metrics: L2Expanded, L2SqrtExpanded, InnerProduct, CosineExpanded
+(the set the reference's IVF-Flat accepts).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import BinaryIO, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import BalancedKMeansParams
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.errors import expects
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import DistanceType, is_min_close, resolve_metric, row_norms
+from raft_tpu.ops.fused_1nn import min_cluster_and_distance
+from raft_tpu.ops.select_k import running_merge, select_k, worst_value
+from raft_tpu.utils.math import round_up
+
+_SUPPORTED = (
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.InnerProduct,
+    DistanceType.CosineExpanded,
+)
+
+
+@dataclasses.dataclass
+class IvfFlatIndexParams:
+    """``ivf_flat::index_params`` analog (``neighbors/ivf_flat_types.hpp:44``)."""
+
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class IvfFlatSearchParams:
+    """``ivf_flat::search_params`` analog (``ivf_flat_types.hpp:155``)."""
+
+    n_probes: int = 20
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class IvfFlatIndex:
+    """Dense-padded inverted-file index (``ivf_flat_types.hpp:129`` analog)."""
+
+    centers: jax.Array  # [n_lists, d] f32
+    list_data: jax.Array  # [n_lists, max_list, d] (dataset dtype)
+    list_indices: jax.Array  # [n_lists, max_list] i32, -1 = empty slot
+    list_sizes: jax.Array  # [n_lists] i32
+    list_norms: Optional[jax.Array]  # [n_lists, max_list] f32 sq norms (L2/cos)
+    metric: DistanceType
+    size: int  # total indexed rows
+
+    def tree_flatten(self):
+        return (
+            (self.centers, self.list_data, self.list_indices, self.list_sizes, self.list_norms),
+            (self.metric, self.size),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, metric=aux[0], size=aux[1])
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def max_list(self) -> int:
+        return self.list_data.shape[1]
+
+
+def _pack_lists(dataset: jax.Array, labels: np.ndarray, n_lists: int, ids: np.ndarray):
+    """Pack rows into the dense [n_lists, max_list, d] layout.
+
+    Host-side packing at build time (the analog of the reference's
+    ``build_index_kernel`` scatter, ``ivf_flat_build.cuh:116``); sizes are
+    data-dependent so this is inherently a host decision point — one sync at
+    build, zero at search.
+    """
+    n, d = dataset.shape
+    counts = np.bincount(labels, minlength=n_lists)
+    max_list = max(8, round_up(int(counts.max()), 8))
+
+    order = np.argsort(labels, kind="stable")
+    within = np.arange(n) - np.repeat(np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
+    slots = labels[order] * max_list + within  # flat destination slot per row
+
+    flat_data = np.zeros((n_lists * max_list, d), dtype=np.asarray(dataset).dtype)
+    flat_ids = np.full((n_lists * max_list,), -1, np.int32)
+    ds_np = np.asarray(dataset)
+    flat_data[slots] = ds_np[order]
+    flat_ids[slots] = ids[order]
+    return (
+        jnp.asarray(flat_data.reshape(n_lists, max_list, d)),
+        jnp.asarray(flat_ids.reshape(n_lists, max_list)),
+        jnp.asarray(counts.astype(np.int32)),
+        max_list,
+    )
+
+
+def build(
+    dataset,
+    params: Optional[IvfFlatIndexParams] = None,
+    res: Optional[Resources] = None,
+    **kwargs,
+) -> IvfFlatIndex:
+    """Train centers with balanced k-means and pack the inverted lists
+    (``ivf_flat::build``, ``detail/ivf_flat_build.cuh:382``)."""
+    res = ensure_resources(res)
+    if params is None:
+        params = IvfFlatIndexParams(**kwargs)
+    metric = resolve_metric(params.metric)
+    expects(metric in _SUPPORTED, "IVF-Flat does not support metric %s", metric)
+    dataset = jnp.asarray(dataset)
+    expects(dataset.ndim == 2, "dataset must be [n_rows, dim]")
+    n, d = dataset.shape
+    n_lists = min(params.n_lists, n)
+
+    train_n = max(n_lists, int(n * params.kmeans_trainset_fraction))
+    ds_f32 = dataset.astype(jnp.float32)
+    trainset = ds_f32
+    if train_n < n:
+        rng = np.random.default_rng(params.seed)
+        trainset = ds_f32[jnp.asarray(rng.permutation(n)[:train_n])]
+
+    assign_data = ds_f32
+    if metric == DistanceType.CosineExpanded:
+        trainset = trainset / jnp.maximum(jnp.linalg.norm(trainset, axis=1, keepdims=True), 1e-12)
+        assign_data = ds_f32 / jnp.maximum(jnp.linalg.norm(ds_f32, axis=1, keepdims=True), 1e-12)
+
+    centers = kmeans_balanced.fit(
+        trainset,
+        BalancedKMeansParams(
+            n_clusters=n_lists,
+            n_iters=params.kmeans_n_iters,
+            metric=DistanceType.L2Expanded,
+            seed=params.seed,
+        ),
+    )
+    labels, _ = min_cluster_and_distance(assign_data, centers, metric=DistanceType.L2Expanded)
+
+    labels_np = np.asarray(labels)
+    list_data, list_indices, list_sizes, _ = _pack_lists(
+        dataset, labels_np, n_lists, np.arange(n, dtype=np.int32)
+    )
+    list_norms = None
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded, DistanceType.CosineExpanded):
+        list_norms = row_norms(list_data.reshape(-1, d)).reshape(list_data.shape[:2])
+    return IvfFlatIndex(
+        centers=centers,
+        list_data=list_data,
+        list_indices=list_indices,
+        list_sizes=list_sizes,
+        list_norms=list_norms,
+        metric=metric,
+        size=n,
+    )
+
+
+def extend(index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
+    """Add vectors to an existing index (``ivf_flat::extend``,
+    ``detail/ivf_flat_build.cuh:163``): assign to nearest centers and repack
+    (centers are kept fixed, as in the reference)."""
+    new_vectors = jnp.asarray(new_vectors)
+    expects(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim, "bad extend shape")
+    n_new = new_vectors.shape[0]
+    if new_ids is None:
+        new_ids = np.arange(index.size, index.size + n_new, dtype=np.int32)
+    else:
+        new_ids = np.asarray(new_ids, np.int32)
+
+    vec_f32 = new_vectors.astype(jnp.float32)
+    if index.metric == DistanceType.CosineExpanded:
+        vec_f32 = vec_f32 / jnp.maximum(jnp.linalg.norm(vec_f32, axis=1, keepdims=True), 1e-12)
+    labels, _ = min_cluster_and_distance(vec_f32, index.centers, metric=DistanceType.L2Expanded)
+
+    # Collect existing rows (valid slots), concat, repack.
+    d = index.dim
+    old_mask = np.asarray(index.list_indices).reshape(-1) >= 0
+    old_data = np.asarray(index.list_data).reshape(-1, d)[old_mask]
+    old_ids = np.asarray(index.list_indices).reshape(-1)[old_mask]
+    old_labels = np.repeat(np.arange(index.n_lists), index.max_list)[old_mask]
+
+    all_data = np.concatenate([old_data, np.asarray(new_vectors)], axis=0)
+    all_ids = np.concatenate([old_ids, new_ids])
+    all_labels = np.concatenate([old_labels, np.asarray(labels)])
+
+    list_data, list_indices, list_sizes, _ = _pack_lists(
+        jnp.asarray(all_data), all_labels, index.n_lists, all_ids
+    )
+    list_norms = None
+    if index.list_norms is not None:
+        list_norms = row_norms(list_data.reshape(-1, d)).reshape(list_data.shape[:2])
+    return IvfFlatIndex(
+        centers=index.centers,
+        list_data=list_data,
+        list_indices=list_indices,
+        list_sizes=list_sizes,
+        list_norms=list_norms,
+        metric=index.metric,
+        size=index.size + n_new,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "has_filter"),
+)
+def _ivf_search_impl(
+    centers,
+    list_data,
+    list_indices,
+    list_norms,
+    queries,
+    filter_bits,
+    *,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    has_filter: bool,
+):
+    nq, d = queries.shape
+    n_lists, max_list = list_indices.shape
+    qf = queries.astype(jnp.float32)
+    if metric == DistanceType.CosineExpanded:
+        qf = qf / jnp.maximum(jnp.linalg.norm(qf, axis=1, keepdims=True), 1e-12)
+
+    # -- coarse: nearest centers (select_clusters, ivf_flat_search-inl.cuh:145)
+    q_dot_c = qf @ centers.T  # [nq, n_lists] (MXU)
+    if metric == DistanceType.InnerProduct:
+        coarse = -q_dot_c
+    else:
+        c_norm = jnp.sum(centers * centers, axis=1)
+        coarse = c_norm[None, :] - 2.0 * q_dot_c  # rankwise == L2 distance
+    _, probes = select_k(coarse, n_probes, select_min=True)  # [nq, n_probes]
+
+    q_sqnorm = jnp.sum(qf * qf, axis=1)
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.float32(worst_value(jnp.float32, select_min))
+
+    init = (
+        jnp.full((nq, k), worst, jnp.float32),
+        jnp.full((nq, k), -1, jnp.int32),
+    )
+
+    def body(carry, p):
+        acc_v, acc_i = carry
+        list_id = probes[:, p]  # [nq]
+        data_p = list_data[list_id]  # [nq, max_list, d] gather
+        ids_p = list_indices[list_id]  # [nq, max_list]
+        dots = jnp.einsum(
+            "qd,qmd->qm", qf, data_p.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        if metric == DistanceType.InnerProduct:
+            dist = dots
+        elif metric == DistanceType.CosineExpanded:
+            # qf is unit-normalized; stored rows are raw, so
+            # 1 - cos = 1 - (q̂·x)/||x||.
+            norms_p = list_norms[list_id]
+            dist = 1.0 - dots * lax.rsqrt(jnp.maximum(norms_p, 1e-24))
+        else:
+            norms_p = list_norms[list_id]
+            dist = q_sqnorm[:, None] + norms_p - 2.0 * dots
+            dist = jnp.maximum(dist, 0.0)
+        valid = ids_p >= 0
+        if has_filter:
+            word = filter_bits[jnp.clip(ids_p, 0, None) // 32]
+            bit = (word >> (jnp.clip(ids_p, 0, None) % 32).astype(jnp.uint32)) & 1
+            valid = valid & (bit == 1)
+        dist = jnp.where(valid, dist, worst)
+        ids_masked = jnp.where(valid, ids_p, -1)
+        return running_merge(acc_v, acc_i, dist, ids_masked, select_min=select_min), None
+
+    (vals, idx), _ = lax.scan(body, init, jnp.arange(n_probes))
+
+    if metric == DistanceType.L2SqrtExpanded:
+        vals = jnp.where(idx >= 0, jnp.sqrt(jnp.maximum(vals, 0.0)), vals)
+    return vals, idx
+
+
+def search(
+    index: IvfFlatIndex,
+    queries,
+    k: int,
+    params: Optional[IvfFlatSearchParams] = None,
+    prefilter: Optional[Bitset] = None,
+    query_batch: int = 1024,
+    res: Optional[Resources] = None,
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """ANN search over probed lists (``ivf_flat::search``,
+    ``detail/ivf_flat_search-inl.cuh:271``). Returns best-first
+    ``(distances [nq, k] f32, indices [nq, k] i32)``; unfilled slots get
+    id -1."""
+    ensure_resources(res)
+    if params is None:
+        params = IvfFlatSearchParams(**kwargs)
+    queries = jnp.asarray(queries)
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim, "bad query shape")
+    expects(k >= 1, "k must be >= 1")
+    if prefilter is not None:
+        expects(prefilter.size >= index.size, "prefilter smaller than index")
+    n_probes = min(params.n_probes, index.n_lists)
+    nq = queries.shape[0]
+
+    filter_bits = prefilter.bits if prefilter is not None else None
+
+    out_v, out_i = [], []
+    for start in range(0, nq, query_batch):
+        qc = queries[start : start + query_batch]
+        bpad = 0
+        if qc.shape[0] < query_batch and nq > query_batch:
+            bpad = query_batch - qc.shape[0]
+            qc = jnp.pad(qc, ((0, bpad), (0, 0)))
+        v, i = _ivf_search_impl(
+            index.centers,
+            index.list_data,
+            index.list_indices,
+            index.list_norms,
+            qc,
+            filter_bits,
+            k=k,
+            n_probes=n_probes,
+            metric=index.metric,
+            has_filter=filter_bits is not None,
+        )
+        if bpad:
+            v, i = v[:-bpad], i[:-bpad]
+        out_v.append(v)
+        out_i.append(i)
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
+
+
+# -- serialization (neighbors/ivf_flat_serialize.cuh analog) ----------------
+
+_KIND = "ivf_flat"
+_VERSION = 1
+
+
+def save(index: IvfFlatIndex, stream: BinaryIO) -> None:
+    ser.dump_header(stream, _KIND, _VERSION)
+    ser.serialize_scalar(stream, int(index.metric), "int32")
+    ser.serialize_scalar(stream, int(index.size), "int64")
+    ser.serialize_scalar(stream, int(index.list_norms is not None), "int32")
+    ser.serialize_array(stream, index.centers)
+    ser.serialize_array(stream, index.list_data)
+    ser.serialize_array(stream, index.list_indices)
+    ser.serialize_array(stream, index.list_sizes)
+    if index.list_norms is not None:
+        ser.serialize_array(stream, index.list_norms)
+
+
+def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfFlatIndex:
+    ensure_resources(res)
+    ser.check_header(stream, _KIND)
+    metric = DistanceType(ser.deserialize_scalar(stream, "int32"))
+    size = int(ser.deserialize_scalar(stream, "int64"))
+    has_norms = bool(ser.deserialize_scalar(stream, "int32"))
+    centers = ser.deserialize_array(stream)
+    list_data = ser.deserialize_array(stream)
+    list_indices = ser.deserialize_array(stream)
+    list_sizes = ser.deserialize_array(stream)
+    list_norms = ser.deserialize_array(stream) if has_norms else None
+    return IvfFlatIndex(
+        centers=centers,
+        list_data=list_data,
+        list_indices=list_indices,
+        list_sizes=list_sizes,
+        list_norms=list_norms,
+        metric=metric,
+        size=size,
+    )

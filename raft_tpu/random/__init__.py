@@ -1,0 +1,38 @@
+"""Random layer (L4 analog): counter-based RNG distributions, sampling,
+make_blobs data gen, R-MAT graph gen.
+
+See ``SURVEY.md`` §2.3 (``/root/reference/cpp/include/raft/random``).
+"""
+from raft_tpu.random.make_blobs import make_blobs
+from raft_tpu.random.rmat import rmat
+from raft_tpu.random.rng import (
+    as_key,
+    bernoulli,
+    excess_subsample,
+    exponential,
+    gumbel,
+    laplace,
+    lognormal,
+    normal,
+    permute,
+    rayleigh,
+    sample_without_replacement,
+    uniform,
+)
+
+__all__ = [
+    "make_blobs",
+    "rmat",
+    "as_key",
+    "bernoulli",
+    "excess_subsample",
+    "exponential",
+    "gumbel",
+    "laplace",
+    "lognormal",
+    "normal",
+    "permute",
+    "rayleigh",
+    "sample_without_replacement",
+    "uniform",
+]

@@ -1,0 +1,60 @@
+"""Synthetic clustered data generation — analog of
+``raft::random::make_blobs`` (``random/make_blobs.cuh``).
+
+Generates isotropic Gaussian blobs with per-cluster centers; used across the
+test suite and benchmarks exactly as in the reference (kmeans tests, ANN
+smoke data).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+from raft_tpu.random.rng import KeyLike, as_key
+
+
+def make_blobs(
+    key: KeyLike,
+    n_samples: int,
+    n_features: int,
+    n_clusters: int = 5,
+    cluster_std: float = 1.0,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    centers: Optional[jax.Array] = None,
+    shuffle: bool = True,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns ``(X [n_samples, n_features], labels [n_samples] i32,
+    centers [n_clusters, n_features])``.
+
+    Samples are distributed round-robin across clusters (matching the
+    reference's equal-proportion default) then optionally shuffled.
+    """
+    expects(n_samples > 0 and n_features > 0 and n_clusters > 0, "sizes must be positive")
+    key = as_key(key)
+    k_centers, k_noise, k_shuffle = jax.random.split(key, 3)
+
+    if centers is None:
+        centers = jax.random.uniform(
+            k_centers,
+            (n_clusters, n_features),
+            minval=center_box[0],
+            maxval=center_box[1],
+            dtype=jnp.float32,
+        )
+    else:
+        centers = jnp.asarray(centers, jnp.float32)
+        expects(centers.shape == (n_clusters, n_features), "centers shape mismatch")
+
+    labels = jnp.arange(n_samples, dtype=jnp.int32) % n_clusters
+    noise = cluster_std * jax.random.normal(k_noise, (n_samples, n_features), jnp.float32)
+    X = centers[labels] + noise
+
+    if shuffle:
+        perm = jax.random.permutation(k_shuffle, n_samples)
+        X = X[perm]
+        labels = labels[perm]
+    return X.astype(dtype), labels, centers

@@ -1,0 +1,60 @@
+"""R-MAT random graph generator — analog of
+``raft::random::rmat_rectangular_gen``
+(``random/rmat_rectangular_generator.cuh``; pylibraft binding
+``random/rmat_rectangular_generator.pyx``).
+
+Generates edges of a power-law graph by recursively descending a 2^r x 2^c
+adjacency matrix, picking one quadrant per bit level with probabilities
+(a, b, c, d). Vectorized over edges and bit levels: one categorical draw
+per (edge, level), folded into src/dst bits — no data-dependent control
+flow, so the whole generator jits to a couple of fused kernels.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+from raft_tpu.random.rng import KeyLike, as_key
+
+
+def rmat(
+    key: KeyLike,
+    n_edges: int,
+    r_scale: int,
+    c_scale: int,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generate ``n_edges`` edges of an R-MAT graph over
+    ``2^r_scale x 2^c_scale`` vertices. Returns ``(src, dst)`` i32 arrays.
+
+    ``d = 1 - a - b - c``. Matches the reference's rectangular variant where
+    row/col scales may differ (``rmat_rectangular_generator.cuh``).
+    """
+    d = 1.0 - a - b - c
+    expects(d >= -1e-6, "rmat probabilities exceed 1")
+    expects(r_scale > 0 and c_scale > 0, "scales must be positive")
+    key = as_key(key)
+    max_scale = max(r_scale, c_scale)
+
+    # One categorical draw per (edge, level): quadrant in {0,1,2,3} encoding
+    # (row_bit, col_bit) = (q >> 1, q & 1).
+    probs = jnp.array([a, b, c, max(d, 0.0)])
+    q = jax.random.categorical(
+        key, jnp.log(probs + 1e-30), shape=(n_edges, max_scale)
+    ).astype(jnp.int32)
+
+    levels = jnp.arange(max_scale, dtype=jnp.int32)
+    # Bit i (from the most significant) applies only if that level is within
+    # the axis' scale.
+    row_bits = (q >> 1) & 1
+    col_bits = q & 1
+    row_weight = jnp.where(levels < r_scale, 1 << (r_scale - 1 - jnp.minimum(levels, r_scale - 1)), 0)
+    col_weight = jnp.where(levels < c_scale, 1 << (c_scale - 1 - jnp.minimum(levels, c_scale - 1)), 0)
+    src = jnp.sum(row_bits * row_weight[None, :], axis=1).astype(jnp.int32)
+    dst = jnp.sum(col_bits * col_weight[None, :], axis=1).astype(jnp.int32)
+    return src, dst

@@ -1,0 +1,113 @@
+"""Counter-based RNG surface — analog of ``raft::random`` RNG
+(``random/rng.cuh``, ``random/rng_state.hpp:30-52``).
+
+The reference uses counter-based PCG/Philox generators seeded through an
+``RngState`` passed into every sampling routine. JAX's ``jax.random`` is
+already counter-based (Threefry) and functional, so ``RngState`` maps to a
+PRNG key; this module provides the reference's distribution surface as thin
+typed wrappers plus the sampling utilities algorithms need
+(``sample_without_replacement``, ``permute``, ``excess_subsample``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+from raft_tpu.core.resources import Resources, ensure_resources
+
+KeyLike = Union[jax.Array, int]
+
+
+def as_key(key: Optional[KeyLike], res: Optional[Resources] = None) -> jax.Array:
+    """Normalize an int seed / key / None (-> resource key stream) to a key.
+
+    The ``RngState(seed)`` analog; ``None`` draws from the handle's stream
+    like the reference's per-handle rng state.
+    """
+    if key is None:
+        return ensure_resources(res).next_key()
+    if isinstance(key, int):
+        return jax.random.key(key)
+    return key
+
+
+# -- distributions (rng.cuh surface) ---------------------------------------
+
+
+def uniform(key: KeyLike, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    """``uniform`` / ``uniformInt`` (``random/rng.cuh``)."""
+    key = as_key(key)
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        return jax.random.randint(key, shape, int(low), int(high), dtype=dtype)
+    return jax.random.uniform(key, shape, dtype=dtype, minval=low, maxval=high)
+
+
+def normal(key: KeyLike, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    """``normal`` (``random/rng.cuh``)."""
+    return mu + sigma * jax.random.normal(as_key(key), shape, dtype=dtype)
+
+
+def lognormal(key: KeyLike, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(key, shape, mu, sigma, dtype))
+
+
+def gumbel(key: KeyLike, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(as_key(key), shape, dtype=dtype)
+
+
+def exponential(key: KeyLike, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(as_key(key), shape, dtype=dtype) / lam
+
+
+def laplace(key: KeyLike, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.laplace(as_key(key), shape, dtype=dtype)
+
+
+def rayleigh(key: KeyLike, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(as_key(key), shape, dtype=dtype, minval=1e-12, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def bernoulli(key: KeyLike, shape, prob=0.5):
+    return jax.random.bernoulli(as_key(key), prob, shape)
+
+
+# -- sampling utilities -----------------------------------------------------
+
+
+def permute(key: KeyLike, n_or_array, axis: int = 0):
+    """Random permutation — ``raft::random::permute`` (``random/permute.cuh``).
+
+    With an int, returns a permutation of ``arange(n)``; with an array,
+    shuffles along ``axis``.
+    """
+    key = as_key(key)
+    if isinstance(n_or_array, int):
+        return jax.random.permutation(key, n_or_array)
+    return jax.random.permutation(key, n_or_array, axis=axis)
+
+
+def sample_without_replacement(
+    key: KeyLike, n_population: int, n_samples: int, weights: Optional[jax.Array] = None
+) -> jax.Array:
+    """Uniform (or weighted) sampling without replacement
+    (``random/sample_without_replacement.cuh``). Returns i32 indices."""
+    expects(n_samples <= n_population, "cannot sample %d from %d", n_samples, n_population)
+    key = as_key(key)
+    if weights is None:
+        return jax.random.permutation(key, n_population)[:n_samples].astype(jnp.int32)
+    # Gumbel top-k trick: exact weighted sampling without replacement.
+    g = jax.random.gumbel(key, (n_population,))
+    scores = jnp.log(jnp.maximum(weights, 1e-30)) + g
+    return jax.lax.top_k(scores, n_samples)[1].astype(jnp.int32)
+
+
+def excess_subsample(key: KeyLike, n_population: int, n_samples: int) -> jax.Array:
+    """Subsample used by IVF-PQ trainset selection
+    (``random/detail/rng_impl.cuh`` ``excess_subsample``): cheap
+    sampling that tolerates near-population sizes; here simply a
+    permutation prefix (exact, and cheap under XLA)."""
+    return sample_without_replacement(key, n_population, n_samples)

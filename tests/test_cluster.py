@@ -1,0 +1,139 @@
+"""K-means tests: inertia vs a plain numpy Lloyd reference on blobs (the
+reference compares score vs its own baseline, ``cpp/test/cluster/kmeans.cu``)
+and balance checks for the balanced variant
+(``cpp/test/cluster/kmeans_balanced.cu`` checks cluster-size uniformity)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster.kmeans import KMeansParams
+from raft_tpu.cluster.kmeans_balanced import BalancedKMeansParams
+from raft_tpu.random import make_blobs
+
+
+def numpy_lloyd(X, k, seed=0, iters=50):
+    rng = np.random.default_rng(seed)
+    centers = X[rng.permutation(len(X))[:k]].copy()
+    for _ in range(iters):
+        d = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        labels = d.argmin(1)
+        for j in range(k):
+            pts = X[labels == j]
+            if len(pts):
+                centers[j] = pts.mean(0)
+    d = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    return d.min(1).sum()
+
+
+@pytest.fixture
+def blobs():
+    X, labels, centers = make_blobs(0, 1500, 12, n_clusters=6, cluster_std=0.8)
+    return np.asarray(X), np.asarray(labels), np.asarray(centers)
+
+
+def test_kmeans_recovers_blobs(blobs):
+    X, true_labels, true_centers = blobs
+    out = kmeans.fit(X, n_clusters=6, seed=0)
+    # Every found centroid must be close to some true center.
+    d = ((np.asarray(out.centroids)[:, None, :] - true_centers[None, :, :]) ** 2).sum(-1)
+    assert (d.min(1) < 1.0).all()
+    # And the assignment must agree with ground truth up to relabeling.
+    found = np.asarray(out.labels)
+    mapping = d.argmin(1)
+    np.testing.assert_array_equal(mapping[found], true_labels)
+
+
+def test_kmeans_inertia_close_to_reference(blobs):
+    X, _, _ = blobs
+    out = kmeans.fit(X, n_clusters=6, seed=0)
+    ref = numpy_lloyd(X, 6)
+    assert float(out.inertia) <= ref * 1.01, (float(out.inertia), ref)
+
+
+def test_kmeans_converges_early(blobs):
+    X, _, _ = blobs
+    out = kmeans.fit(X, n_clusters=6, max_iter=300, seed=0)
+    assert int(out.n_iter) < 50
+
+
+def test_kmeans_random_init_with_restarts(blobs):
+    # Single random init can land in a bad local minimum; n_init restarts
+    # must keep the best trial (kmeans_types.hpp n_init semantics).
+    X, _, _ = blobs
+    out = kmeans.fit(X, n_clusters=6, init="random", n_init=5, seed=1)
+    ref = numpy_lloyd(X, 6)
+    assert float(out.inertia) <= ref * 1.10
+
+
+def test_kmeans_explicit_centroids(blobs):
+    X, _, true_centers = blobs
+    out = kmeans.fit(X, KMeansParams(n_clusters=6), centroids=jnp.asarray(true_centers))
+    d = ((np.asarray(out.centroids)[:, None, :] - true_centers[None, :, :]) ** 2).sum(-1)
+    assert (d.min(1) < 1.0).all()
+
+
+def test_predict_matches_fit_labels(blobs):
+    X, _, _ = blobs
+    out = kmeans.fit(X, n_clusters=6, seed=0)
+    labels, dists = kmeans.predict(X, out.centroids)
+    np.testing.assert_array_equal(np.asarray(labels), np.asarray(out.labels))
+    assert (np.asarray(dists) >= 0).all()
+
+
+def test_transform_shape(blobs):
+    X, _, _ = blobs
+    out = kmeans.fit(X, n_clusters=6, seed=0)
+    T = kmeans.transform(X, out.centroids)
+    assert T.shape == (1500, 6)
+    np.testing.assert_array_equal(np.asarray(T).argmin(1), np.asarray(out.labels))
+
+
+def test_kmeans_cosine(blobs):
+    X, _, _ = blobs
+    X = X + 20.0  # keep away from the origin for stable cosine
+    out = kmeans.fit(X, n_clusters=4, metric="cosine", seed=0)
+    assert float(out.inertia) >= 0
+
+
+# -- balanced ---------------------------------------------------------------
+
+
+def test_balanced_sizes(blobs):
+    X, _, _ = blobs
+    k = 16
+    centers = kmeans_balanced.fit(X, n_clusters=k, seed=0)
+    labels, _ = kmeans_balanced.predict(X, centers)
+    counts = np.bincount(np.asarray(labels), minlength=k)
+    avg = len(X) / k
+    # No empty lists, and no pathological imbalance (reference tolerance:
+    # cluster sizes within a small constant factor of the mean).
+    assert counts.min() > 0, counts
+    assert counts.max() < avg * 4, counts
+
+
+def test_balanced_small_k(blobs):
+    X, _, _ = blobs
+    centers = kmeans_balanced.fit(X, n_clusters=4, seed=0)
+    assert centers.shape == (4, 12)
+    labels, _ = kmeans_balanced.predict(X, centers)
+    counts = np.bincount(np.asarray(labels), minlength=4)
+    assert counts.min() > 0
+
+
+def test_balanced_quality(blobs):
+    # Balanced constraint costs some inertia but must stay in the same
+    # ballpark as unconstrained Lloyd.
+    X, _, _ = blobs
+    centers = kmeans_balanced.fit(X, n_clusters=6, seed=0)
+    _, dists = kmeans_balanced.predict(X, centers)
+    ref = numpy_lloyd(X, 6)
+    assert float(np.asarray(dists).sum()) <= ref * 2.0
+
+
+def test_balanced_fit_predict(blobs):
+    X, _, _ = blobs
+    centers, labels = kmeans_balanced.fit_predict(X, n_clusters=8, seed=0)
+    assert centers.shape == (8, 12)
+    assert np.asarray(labels).shape == (1500,)

@@ -1,0 +1,160 @@
+"""IVF-Flat tests: recall-threshold vs exact kNN (``cpp/test/neighbors/
+ann_ivf_flat.cuh`` pattern), extend, filters, serialization, metrics."""
+import io
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, IvfFlatSearchParams
+from raft_tpu.ops import DistanceType
+from raft_tpu.stats import neighborhood_recall
+
+N, D, NQ, K = 20_000, 32, 200, 10
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    dataset = rng.standard_normal((N, D)).astype(np.float32)
+    queries = rng.standard_normal((NQ, D)).astype(np.float32)
+    return dataset, queries
+
+
+@pytest.fixture(scope="module")
+def index(data):
+    dataset, _ = data
+    return ivf_flat.build(dataset, IvfFlatIndexParams(n_lists=64, metric=DistanceType.L2Expanded, seed=0))
+
+
+def exact(dataset, queries, k, metric=DistanceType.L2Expanded):
+    bf = brute_force.build(dataset, metric=metric)
+    return brute_force.search(bf, queries, k)
+
+
+def test_recall_at_probes(data, index):
+    dataset, queries = data
+    _, ref_idx = exact(dataset, queries, K)
+    dist, idx = ivf_flat.search(index, queries, K, IvfFlatSearchParams(n_probes=32))
+    recall = float(neighborhood_recall(np.asarray(idx), np.asarray(ref_idx)))
+    assert recall >= 0.95, recall
+
+
+def test_recall_improves_with_probes(data, index):
+    dataset, queries = data
+    _, ref_idx = exact(dataset, queries, K)
+    recalls = []
+    for np_ in (1, 8, 64):
+        _, idx = ivf_flat.search(index, queries, K, n_probes=np_)
+        recalls.append(float(neighborhood_recall(np.asarray(idx), np.asarray(ref_idx))))
+    assert recalls[0] < recalls[2]
+    assert recalls[2] >= 0.99, recalls
+
+
+def test_all_probes_equals_exact(data, index):
+    # Probing every list must return exactly the brute-force answer.
+    dataset, queries = data
+    ref_dist, ref_idx = exact(dataset, queries, K)
+    dist, idx = ivf_flat.search(index, queries, K, n_probes=64)
+    recall = float(neighborhood_recall(np.asarray(idx), np.asarray(ref_idx),
+                                       np.asarray(dist), np.asarray(ref_dist)))
+    assert recall >= 0.9999, recall
+
+
+def test_distances_are_exact_for_found(data, index):
+    # IVF-Flat stores raw vectors: distances of returned ids must equal the
+    # true L2^2 to those rows.
+    dataset, queries = data
+    dist, idx = ivf_flat.search(index, queries, K, n_probes=16)
+    dist, idx = np.asarray(dist), np.asarray(idx)
+    for q in range(0, NQ, 37):
+        for j in range(K):
+            if idx[q, j] >= 0:
+                true = ((queries[q] - dataset[idx[q, j]]) ** 2).sum()
+                np.testing.assert_allclose(dist[q, j], true, rtol=1e-3, atol=1e-2)
+
+
+def test_inner_product(data):
+    dataset, queries = data
+    idx_ip = ivf_flat.build(dataset, n_lists=64, metric=DistanceType.InnerProduct, seed=0)
+    _, ref_idx = exact(dataset, queries, K, metric=DistanceType.InnerProduct)
+    _, idx = ivf_flat.search(idx_ip, queries, K, n_probes=32)
+    recall = float(neighborhood_recall(np.asarray(idx), np.asarray(ref_idx)))
+    assert recall >= 0.9, recall
+
+
+def test_cosine(data):
+    dataset, queries = data
+    idx_cos = ivf_flat.build(dataset, n_lists=64, metric=DistanceType.CosineExpanded, seed=0)
+    _, ref_idx = exact(dataset, queries, K, metric=DistanceType.CosineExpanded)
+    dist, idx = ivf_flat.search(idx_cos, queries, K, n_probes=32)
+    recall = float(neighborhood_recall(np.asarray(idx), np.asarray(ref_idx)))
+    assert recall >= 0.9, recall
+    # cosine distances live in [0, 2]
+    d = np.asarray(dist)
+    assert d[np.asarray(idx) >= 0].min() >= -1e-4
+    assert d[np.asarray(idx) >= 0].max() <= 2.0 + 1e-4
+
+
+def test_l2sqrt_distances(data, index):
+    dataset, queries = data
+    idx_sqrt = ivf_flat.build(dataset, n_lists=64, metric=DistanceType.L2SqrtExpanded, seed=0)
+    d1, i1 = ivf_flat.search(idx_sqrt, queries[:20], K, n_probes=64)
+    ref_d, ref_i = exact(dataset, queries[:20], K, metric=DistanceType.L2SqrtExpanded)
+    np.testing.assert_allclose(np.sort(np.asarray(d1)), np.sort(np.asarray(ref_d)), rtol=1e-3, atol=1e-3)
+
+
+def test_prefilter(data, index):
+    dataset, queries = data
+    _, base = ivf_flat.search(index, queries, 1, n_probes=64)
+    banned = np.unique(np.asarray(base).ravel())
+    keep = np.ones(N, bool)
+    keep[banned] = False
+    bs = Bitset.from_mask(jnp.asarray(keep))
+    _, idx = ivf_flat.search(index, queries, K, n_probes=64, prefilter=bs)
+    assert not np.isin(np.asarray(idx), banned).any()
+
+
+def test_extend(data, index):
+    dataset, queries = data
+    rng = np.random.default_rng(9)
+    extra = rng.standard_normal((3000, D)).astype(np.float32)
+    bigger = ivf_flat.extend(index, extra)
+    assert bigger.size == N + 3000
+    full = np.concatenate([dataset, extra], axis=0)
+    _, ref_idx = exact(full, queries, K)
+    _, idx = ivf_flat.search(bigger, queries, K, n_probes=32)
+    recall = float(neighborhood_recall(np.asarray(idx), np.asarray(ref_idx)))
+    assert recall >= 0.95, recall
+    # ids of extended rows must appear (some queries' neighbors are new rows)
+    assert (np.asarray(idx) >= N).any()
+
+
+def test_serialize_roundtrip(data, index):
+    _, queries = data
+    buf = io.BytesIO()
+    ivf_flat.save(index, buf)
+    buf.seek(0)
+    loaded = ivf_flat.load(buf)
+    d1, i1 = ivf_flat.search(index, queries, K, n_probes=16)
+    d2, i2 = ivf_flat.search(loaded, queries, K, n_probes=16)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    assert loaded.metric == index.metric and loaded.size == index.size
+
+
+def test_list_sizes_balanced(index):
+    sizes = np.asarray(index.list_sizes)
+    assert sizes.sum() == N
+    assert sizes.min() > 0
+    avg = N / 64
+    assert sizes.max() < avg * 4, sizes.max()
+
+
+def test_query_batching(data, index):
+    _, queries = data
+    d1, i1 = ivf_flat.search(index, queries, K, n_probes=8, query_batch=64)
+    d2, i2 = ivf_flat.search(index, queries, K, n_probes=8, query_batch=NQ)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

@@ -1,0 +1,106 @@
+"""Tests for the random layer: determinism under fixed seeds + statistical
+sanity (the reference's rng test pattern, ``cpp/test/random/rng.cu``)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import random as rr
+
+
+def test_deterministic_under_seed():
+    a = np.asarray(rr.uniform(42, (100,)))
+    b = np.asarray(rr.uniform(42, (100,)))
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(rr.uniform(43, (100,)))
+    assert not np.array_equal(a, c)
+
+
+def test_uniform_bounds_and_mean():
+    x = np.asarray(rr.uniform(0, (20000,), low=2.0, high=4.0))
+    assert x.min() >= 2.0 and x.max() < 4.0
+    assert abs(x.mean() - 3.0) < 0.05
+
+
+def test_uniform_int():
+    x = np.asarray(rr.uniform(0, (1000,), low=0, high=10, dtype=jnp.int32))
+    assert x.min() >= 0 and x.max() < 10
+    assert x.dtype == np.int32
+
+
+def test_normal_moments():
+    x = np.asarray(rr.normal(1, (50000,), mu=5.0, sigma=2.0))
+    assert abs(x.mean() - 5.0) < 0.1
+    assert abs(x.std() - 2.0) < 0.1
+
+
+def test_lognormal_positive():
+    assert np.asarray(rr.lognormal(2, (1000,))).min() > 0
+
+
+def test_bernoulli_rate():
+    x = np.asarray(rr.bernoulli(3, (20000,), prob=0.3))
+    assert abs(x.mean() - 0.3) < 0.02
+
+
+def test_rayleigh_positive():
+    x = np.asarray(rr.rayleigh(4, (10000,), sigma=2.0))
+    assert x.min() > 0
+    # mean of Rayleigh = sigma*sqrt(pi/2)
+    assert abs(x.mean() - 2.0 * np.sqrt(np.pi / 2)) < 0.1
+
+
+def test_permute_is_permutation():
+    p = np.asarray(rr.permute(0, 100))
+    np.testing.assert_array_equal(np.sort(p), np.arange(100))
+
+
+def test_permute_array_rows():
+    x = np.arange(50, dtype=np.float32).reshape(10, 5)
+    shuffled = np.asarray(rr.permute(1, jnp.asarray(x)))
+    assert not np.array_equal(shuffled, x)
+    np.testing.assert_array_equal(np.sort(shuffled[:, 0]), x[:, 0])
+
+
+def test_sample_without_replacement_unique():
+    idx = np.asarray(rr.sample_without_replacement(0, 1000, 100))
+    assert len(np.unique(idx)) == 100
+    assert idx.min() >= 0 and idx.max() < 1000
+
+
+def test_sample_without_replacement_weighted():
+    # Heavily weight the first 10 items; they must dominate the sample.
+    w = jnp.concatenate([jnp.full((10,), 1000.0), jnp.full((990,), 0.001)])
+    idx = np.asarray(rr.sample_without_replacement(0, 1000, 10, weights=w))
+    assert len(np.unique(idx)) == 10
+    assert (idx < 10).sum() >= 9
+
+
+def test_make_blobs_separable():
+    X, labels, centers = rr.make_blobs(0, 600, 8, n_clusters=3, cluster_std=0.1)
+    X, labels, centers = np.asarray(X), np.asarray(labels), np.asarray(centers)
+    assert X.shape == (600, 8) and labels.shape == (600,) and centers.shape == (3, 8)
+    # every point is closest to its own cluster's center
+    d = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.argmin(d, axis=1), labels)
+
+
+def test_make_blobs_explicit_centers():
+    centers = np.array([[0.0, 0.0], [100.0, 100.0]], np.float32)
+    X, labels, c = rr.make_blobs(0, 100, 2, n_clusters=2, centers=centers, cluster_std=0.5)
+    np.testing.assert_array_equal(np.asarray(c), centers)
+
+
+def test_rmat_shapes_and_ranges():
+    src, dst = rr.rmat(0, 5000, r_scale=8, c_scale=6, a=0.57, b=0.19, c=0.19)
+    src, dst = np.asarray(src), np.asarray(dst)
+    assert src.shape == dst.shape == (5000,)
+    assert src.min() >= 0 and src.max() < 256
+    assert dst.min() >= 0 and dst.max() < 64
+
+
+def test_rmat_skew():
+    # With a=0.9 nearly all mass lands in the low-index quadrants.
+    src, dst = rr.rmat(0, 10000, r_scale=10, c_scale=10, a=0.9, b=0.04, c=0.04)
+    assert np.median(np.asarray(src)) < 100
