@@ -47,7 +47,8 @@ class KMeansParams:
     metric: DistanceType = DistanceType.L2Expanded
     seed: int = 0
     oversampling_factor: float = 2.0  # kept for param parity; unused by Lloyd
-    batch_samples: int = 1 << 15
+    batch_samples: int = 1 << 15  # kept for param parity; the E step is
+    #   already memory-bounded by the fused argmin scan, so no batching knob
 
 
 @dataclasses.dataclass
@@ -58,11 +59,13 @@ class KMeansOutput:
     n_iter: jax.Array  # scalar i32
 
 
-def kmeans_plus_plus(key, X: jax.Array, k: int) -> jax.Array:
+def kmeans_plus_plus(key, X: jax.Array, k: int, sample_weights=None) -> jax.Array:
     """k-means++ seeding (``cluster/detail/kmeans.cuh:91`` kmeansPlusPlus):
     first center uniform, then each next center sampled with probability
-    proportional to squared distance to the nearest chosen center."""
+    proportional to (weighted) squared distance to the nearest chosen
+    center."""
     n, d = X.shape
+    w = jnp.ones((n,), jnp.float32) if sample_weights is None else jnp.asarray(sample_weights, jnp.float32)
     k0, kloop = jax.random.split(key)
     first = jax.random.randint(k0, (), 0, n)
     centers = jnp.zeros((k, d), jnp.float32).at[0].set(X[first])
@@ -71,8 +74,8 @@ def kmeans_plus_plus(key, X: jax.Array, k: int) -> jax.Array:
     def body(i, carry):
         centers, min_d2, kk = carry
         kk, ksel = jax.random.split(kk)
-        # Sample proportional to min_d2 (log-categorical; zero-safe).
-        logits = jnp.log(jnp.maximum(min_d2, 1e-30))
+        # Sample proportional to w * min_d2 (log-categorical; zero-safe).
+        logits = jnp.log(jnp.maximum(w * min_d2, 1e-30))
         idx = jax.random.categorical(ksel, logits)
         c = X[idx]
         centers = centers.at[i].set(c)
@@ -83,13 +86,13 @@ def kmeans_plus_plus(key, X: jax.Array, k: int) -> jax.Array:
     return centers
 
 
-def _update_centroids(X, labels, k: int, old_centroids):
-    """M step (``cluster/detail/kmeans.cuh:288`` update_centroids): mean of
-    assigned points; empty clusters keep their previous centroid (the
+def _update_centroids(X, labels, k: int, old_centroids, weights):
+    """M step (``cluster/detail/kmeans.cuh:288`` update_centroids): weighted
+    mean of assigned points; empty clusters keep their previous centroid (the
     reference copies the old center for weight-0 clusters)."""
-    sums = jax.ops.segment_sum(X, labels, num_segments=k)
-    counts = jax.ops.segment_sum(jnp.ones((X.shape[0],), jnp.float32), labels, num_segments=k)
-    means = sums / jnp.maximum(counts[:, None], 1.0)
+    sums = jax.ops.segment_sum(X * weights[:, None], labels, num_segments=k)
+    counts = jax.ops.segment_sum(weights, labels, num_segments=k)
+    means = sums / jnp.maximum(counts[:, None], 1e-9)
     return jnp.where(counts[:, None] > 0, means, old_centroids), counts
 
 
@@ -116,6 +119,23 @@ def fit(
     k = params.n_clusters
     expects(0 < k <= n, "n_clusters=%d out of range for %d samples", k, n)
 
+    expects(
+        params.init != "array" or centroids is not None,
+        "init='array' requires an explicit centroids argument",
+    )
+    weights = (
+        jnp.ones((n,), jnp.float32)
+        if sample_weights is None
+        else jnp.asarray(sample_weights, jnp.float32)
+    )
+    expects(weights.shape == (n,), "sample_weights must be [n_samples]")
+
+    # Whether a smaller "inertia" is better depends on the metric direction
+    # (InnerProduct assignment scores are similarities, larger = better).
+    from raft_tpu.ops.distance import is_min_close
+
+    min_close = is_min_close(metric)
+
     key = as_key(params.seed)
     best = None
     for trial in range(max(1, params.n_init)):
@@ -127,17 +147,22 @@ def fit(
             idx = jax.random.permutation(kinit, n)[:k]
             init_centers = X[idx]
         else:
-            init_centers = kmeans_plus_plus(kinit, X, k)
+            init_centers = kmeans_plus_plus(kinit, X, k, sample_weights)
 
-        out = _lloyd(X, init_centers, k, metric, params.max_iter, params.tol)
-        if best is None or float(out.inertia) < float(best.inertia):
+        out = _lloyd(X, init_centers, k, metric, params.max_iter, params.tol, weights)
+        better = best is None or (
+            float(out.inertia) < float(best.inertia)
+            if min_close
+            else float(out.inertia) > float(best.inertia)
+        )
+        if better:
             best = out
         if centroids is not None:
             break
     return best
 
 
-def _lloyd(X, init_centers, k: int, metric, max_iter: int, tol: float) -> KMeansOutput:
+def _lloyd(X, init_centers, k: int, metric, max_iter: int, tol: float, weights) -> KMeansOutput:
     n = X.shape[0]
     tol2 = jnp.float32(tol * tol)
 
@@ -148,9 +173,9 @@ def _lloyd(X, init_centers, k: int, metric, max_iter: int, tol: float) -> KMeans
     def body(carry):
         centers, _, it, _, _ = carry
         labels, dists = min_cluster_and_distance(X, centers, metric=metric)
-        new_centers, _ = _update_centroids(X, labels, k, centers)
+        new_centers, _ = _update_centroids(X, labels, k, centers, weights)
         shift2 = jnp.sum((new_centers - centers) ** 2)
-        inertia = jnp.sum(dists)
+        inertia = jnp.sum(weights * dists)
         return new_centers, labels, it + 1, shift2, inertia
 
     init = (
@@ -163,7 +188,9 @@ def _lloyd(X, init_centers, k: int, metric, max_iter: int, tol: float) -> KMeans
     centers, labels, n_iter, _, _ = lax.while_loop(cond, body, init)
     # Final E step so labels/inertia match the returned centroids.
     labels, dists = min_cluster_and_distance(X, centers, metric=metric)
-    return KMeansOutput(centroids=centers, labels=labels, inertia=jnp.sum(dists), n_iter=n_iter)
+    return KMeansOutput(
+        centroids=centers, labels=labels, inertia=jnp.sum(weights * dists), n_iter=n_iter
+    )
 
 
 def predict(X, centroids, metric=DistanceType.L2Expanded) -> Tuple[jax.Array, jax.Array]:

@@ -71,7 +71,7 @@ def _weighted_lloyd(X, weights, init_centers, k: int, metric, n_iters: int):
     return lax.fori_loop(0, n_iters, body, init_centers)
 
 
-def _adjust_centers(key, X, centers, counts, threshold: float):
+def _adjust_centers(key, X, centers, labels, counts, threshold: float):
     """Re-seed under-populated clusters onto random data points, biased
     toward points in crowded clusters (``adjust_centers``,
     ``kmeans_balanced.cuh:98-180``)."""
@@ -79,13 +79,16 @@ def _adjust_centers(key, X, centers, counts, threshold: float):
     n = X.shape[0]
     avg = n / k
     small = counts < (avg * threshold)
-    # One candidate point per cluster, drawn uniformly; the average-weighted
-    # blend (W = 7) matches the reference's smoothing so a re-seeded center
-    # keeps some memory of its old position.
-    idx = jax.random.randint(key, (k,), 0, n)
+    # One candidate point per cluster, drawn with probability proportional to
+    # the population of the cluster the point currently belongs to (the
+    # reference's scan accepts points from crowded clusters).
+    logits = jnp.log(jnp.maximum(counts[labels], 1e-9))
+    idx = jax.random.categorical(key, logits, shape=(k,))
     candidates = X[idx]
+    # Average-weighted blend (W = 7, kAdjustCentersWeight): the old center
+    # keeps most of its position, nudged toward the candidate point.
     w = _ADJUST_WEIGHT
-    blended = (centers * 1.0 + candidates * w) / (1.0 + w)
+    blended = (centers * w + candidates) / (w + 1.0)
     return jnp.where(small[:, None], blended, centers), small.sum()
 
 
@@ -101,7 +104,7 @@ def _em_iters(key, X, centers, k: int, metric, n_iters: int, threshold: float):
         counts = jax.ops.segment_sum(jnp.ones((X.shape[0],), jnp.float32), labels, num_segments=k)
         means = sums / jnp.maximum(counts[:, None], 1.0)
         centers = jnp.where(counts[:, None] > 0, means, centers)
-        centers, _ = _adjust_centers(kadj, X, centers, counts, threshold)
+        centers, _ = _adjust_centers(kadj, X, centers, labels, counts, threshold)
         return centers, kk
 
     centers, _ = lax.fori_loop(0, n_iters, body, (centers, key))

@@ -38,9 +38,18 @@ def as_key(key: Optional[KeyLike], res: Optional[Resources] = None) -> jax.Array
 
 
 def uniform(key: KeyLike, shape, low=0.0, high=1.0, dtype=jnp.float32):
-    """``uniform`` / ``uniformInt`` (``random/rng.cuh``)."""
+    """``uniform`` / ``uniformInt`` (``random/rng.cuh``).
+
+    Integer dtypes require explicit integer bounds with ``high > low + 1``
+    (the default float bounds would silently degenerate to all-zeros)."""
     key = as_key(key)
     if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        expects(
+            int(high) > int(low) + 1 or (low, high) != (0.0, 1.0),
+            "integer uniform requires explicit integer bounds, got [%s, %s)",
+            low,
+            high,
+        )
         return jax.random.randint(key, shape, int(low), int(high), dtype=dtype)
     return jax.random.uniform(key, shape, dtype=dtype, minval=low, maxval=high)
 

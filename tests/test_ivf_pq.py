@@ -1,0 +1,189 @@
+"""IVF-PQ tests — mirror the reference's recall-threshold pattern
+(``cpp/test/neighbors/ann_ivf_pq.cuh``): compare ANN results against exact
+brute-force kNN and assert recall above a threshold, not exact equality.
+"""
+import io
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, ivf_pq, refine
+from raft_tpu.neighbors.ivf_pq import (
+    IvfPqIndexParams,
+    IvfPqSearchParams,
+    PER_CLUSTER,
+    PER_SUBSPACE,
+)
+from raft_tpu.ops.distance import DistanceType
+from raft_tpu.stats import neighborhood_recall
+
+
+def _clustered(rng, n, d, n_centers=32, scale=0.15):
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32)
+    labels = rng.integers(0, n_centers, n)
+    return (centers[labels] + scale * rng.standard_normal((n, d))).astype(np.float32)
+
+
+def _exact(dataset, queries, k, metric=DistanceType.L2Expanded):
+    idx = brute_force.build(dataset, metric=metric)
+    return brute_force.search(idx, queries, k)
+
+
+class TestIvfPqBuild:
+    def test_shapes_and_packing(self, rng):
+        n, d = 2000, 32
+        X = _clustered(rng, n, d)
+        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=16, pq_dim=8, seed=1))
+        assert index.pq_dim == 8
+        assert index.ksub == 256
+        assert index.rot_dim == 32
+        assert index.codes.shape[0] == 16
+        assert index.codes.shape[2] == 8
+        # every row lands in exactly one list slot
+        ids = np.asarray(index.list_indices)
+        valid = ids[ids >= 0]
+        assert len(valid) == n
+        assert sorted(valid.tolist()) == list(range(n))
+        assert int(np.asarray(index.list_sizes).sum()) == n
+
+    def test_default_pq_dim_heuristic(self):
+        # matches ivf_pq_types.hpp:588 calculate_pq_dim behavior
+        assert ivf_pq._default_pq_dim(128) == 64
+        assert ivf_pq._default_pq_dim(256) == 128
+        assert ivf_pq._default_pq_dim(96) == 96
+        assert ivf_pq._default_pq_dim(20) == 16
+
+    def test_rotation_orthonormal_when_padding(self, rng):
+        n, d = 500, 30  # 30 not divisible by pq_dim=8 -> rot_dim=32, random R
+        X = _clustered(rng, n, d)
+        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=0))
+        R = np.asarray(index.rotation)
+        assert R.shape == (32, 30)
+        # isometry on the input space: ||R x|| == ||x|| for all x in R^30
+        np.testing.assert_allclose(R.T @ R, np.eye(30), atol=1e-4)
+
+
+class TestIvfPqSearch:
+    @pytest.mark.parametrize("codebook_kind", [PER_SUBSPACE, PER_CLUSTER])
+    def test_recall_l2(self, rng, codebook_kind):
+        n, d, nq, k = 6000, 32, 64, 10
+        X = _clustered(rng, n, d)
+        Q = _clustered(rng, nq, d)
+        index = ivf_pq.build(
+            X, IvfPqIndexParams(n_lists=32, pq_dim=16, codebook_kind=codebook_kind, seed=2)
+        )
+        _, ref_i = _exact(X, Q, k)
+        _, ann_i = ivf_pq.search(index, Q, k, IvfPqSearchParams(n_probes=16))
+        recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
+        assert recall >= 0.7, f"recall {recall}"
+
+    def test_recall_with_refine(self, rng):
+        n, d, nq, k = 6000, 32, 64, 10
+        X = _clustered(rng, n, d)
+        Q = _clustered(rng, nq, d)
+        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=32, pq_dim=8, seed=3))
+        _, ref_i = _exact(X, Q, k)
+        # over-fetch 4x then exact re-rank (the reference's refine pattern)
+        _, cand = ivf_pq.search(index, Q, 4 * k, IvfPqSearchParams(n_probes=32))
+        _, ann_i = refine(X, Q, cand, k, metric=DistanceType.L2Expanded)
+        recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
+        assert recall >= 0.95, f"refined recall {recall}"
+
+    def test_inner_product(self, rng):
+        n, d, nq, k = 4000, 32, 32, 10
+        X = _clustered(rng, n, d)
+        X /= np.linalg.norm(X, axis=1, keepdims=True)
+        Q = _clustered(rng, nq, d)
+        index = ivf_pq.build(
+            X, IvfPqIndexParams(n_lists=16, pq_dim=16, metric=DistanceType.InnerProduct, seed=4)
+        )
+        _, ref_i = _exact(X, Q, k, metric=DistanceType.InnerProduct)
+        _, ann_i = ivf_pq.search(index, Q, k, IvfPqSearchParams(n_probes=12))
+        recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
+        assert recall >= 0.6, f"IP recall {recall}"
+
+    def test_l2sqrt_matches_l2_ranking(self, rng):
+        n, d, nq, k = 2000, 16, 16, 5
+        X = _clustered(rng, n, d)
+        Q = _clustered(rng, nq, d)
+        i1 = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=5))
+        i2 = ivf_pq.build(
+            X, IvfPqIndexParams(n_lists=8, pq_dim=8, metric=DistanceType.L2SqrtExpanded, seed=5)
+        )
+        v1, idx1 = ivf_pq.search(i1, Q, k, n_probes=8)
+        v2, idx2 = ivf_pq.search(i2, Q, k, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(idx1), np.asarray(idx2))
+        np.testing.assert_allclose(
+            np.sqrt(np.maximum(np.asarray(v1), 0)), np.asarray(v2), atol=1e-3
+        )
+
+    def test_bf16_lut_mode(self, rng):
+        import jax.numpy as jnp
+
+        n, d, nq, k = 3000, 32, 32, 10
+        X = _clustered(rng, n, d)
+        Q = _clustered(rng, nq, d)
+        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=16, pq_dim=16, seed=6))
+        _, ref_i = _exact(X, Q, k)
+        _, ann_i = ivf_pq.search(
+            index, Q, k, IvfPqSearchParams(n_probes=16, lut_dtype=jnp.bfloat16)
+        )
+        recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
+        assert recall >= 0.6, f"bf16-LUT recall {recall}"
+
+    def test_prefilter(self, rng):
+        from raft_tpu.core.bitset import Bitset
+
+        n, d, nq, k = 2000, 16, 16, 5
+        X = _clustered(rng, n, d)
+        Q = _clustered(rng, nq, d)
+        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=7))
+        banned = np.arange(0, n, 2, dtype=np.int32)  # ban all even ids
+        bs = Bitset.create(n, default=True).unset(banned)
+        _, idx = ivf_pq.search(index, Q, k, n_probes=8, prefilter=bs)
+        idx = np.asarray(idx)
+        assert ((idx % 2 == 1) | (idx < 0)).all()
+
+    def test_nearly_exact_when_uncompressed(self, rng):
+        # pq_dim == dim with 8-bit codebooks on a small set: ADC error tiny.
+        n, d, nq, k = 1500, 16, 24, 5
+        X = _clustered(rng, n, d, n_centers=8)
+        Q = _clustered(rng, nq, d, n_centers=8)
+        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=4, pq_dim=16, seed=8))
+        _, ref_i = _exact(X, Q, k)
+        _, ann_i = ivf_pq.search(index, Q, k, n_probes=4)
+        recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
+        assert recall >= 0.9, f"uncompressed recall {recall}"
+
+
+class TestIvfPqExtendSerialize:
+    def test_extend(self, rng):
+        n, d = 2000, 16
+        X = _clustered(rng, n, d)
+        X2 = _clustered(rng, 500, d)
+        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=9))
+        bigger = ivf_pq.extend(index, X2)
+        assert bigger.size == n + 500
+        ids = np.asarray(bigger.list_indices)
+        assert (ids[ids >= 0] < n + 500).all()
+        assert len(ids[ids >= 0]) == n + 500
+        # extended rows are findable
+        _, idx = ivf_pq.search(bigger, X2[:8], 3, n_probes=8)
+        hits = (np.asarray(idx) >= n).any(axis=1)
+        assert hits.mean() >= 0.75
+
+    def test_serialize_roundtrip(self, rng):
+        n, d, nq, k = 1500, 16, 8, 5
+        X = _clustered(rng, n, d)
+        Q = _clustered(rng, nq, d)
+        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=10))
+        buf = io.BytesIO()
+        ivf_pq.save(index, buf)
+        buf.seek(0)
+        loaded = ivf_pq.load(buf)
+        v1, i1 = ivf_pq.search(index, Q, k, n_probes=8)
+        v2, i2 = ivf_pq.search(loaded, Q, k, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=1e-6)
+        assert loaded.pq_bits == index.pq_bits
+        assert loaded.codebook_kind == index.codebook_kind
