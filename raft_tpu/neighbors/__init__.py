@@ -3,7 +3,7 @@ NN-descent, refine, filters.
 
 See ``SURVEY.md`` §2.4 (``/root/reference/cpp/include/raft/neighbors``).
 """
-from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, nn_descent
 from raft_tpu.neighbors.refine import refine
 
-__all__ = ["brute_force", "ivf_flat", "ivf_pq", "refine"]
+__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "nn_descent", "refine"]

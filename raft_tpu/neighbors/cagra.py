@@ -1,0 +1,478 @@
+"""CAGRA graph index — analog of ``raft::neighbors::cagra``.
+
+Reference: build ``neighbors/detail/cagra/cagra_build.cuh:47,238,263``
+(kNN graph via IVF-PQ search or NN-descent), 2-hop detour pruning
+``detail/cagra/graph_core.cuh:130`` (``kern_prune``) + reverse-edge merge
+(``graph_core.cuh:440-560``), search plan ``detail/cagra/search_plan.cuh:81``
+and single-CTA greedy beam search
+``detail/cagra/search_single_cta_kernel-inl.cuh:467``
+(``pickup_next_parents:54``, bitonic topk ``:97,200``, visited hashmap
+``detail/cagra/hashmap.hpp``). Index type ``neighbors/cagra_types.hpp:142``.
+
+TPU-first redesign:
+
+* **Pruning** is a dense batched computation: the detour count of edge
+  A->B_rank_b — #{a < b : B ∈ G[G[A,a]]} — comes from a two-hop gather plus
+  an equality-reduction scan over the higher-ranked neighbor axis; edges are
+  then re-ranked by (detour_count, original rank) with one argsort. No
+  atomics, no per-node kernels.
+* **Reverse-edge merge** keeps the first ``degree/2`` forward edges
+  protected and fills the tail with rank-limited reverse edges followed by
+  the remaining forward edges, deduplicated with a sort-based keep-first
+  compaction — the vectorized equivalent of the reference's shift-insert
+  loop.
+* **Search** is a fixed-iteration batched beam search under ``jit``: an
+  ``itopk``-slot candidate buffer per query carries (distance, id, visited)
+  — the visited *hashmap* becomes a visited *flag lane* merged by a
+  sort-dedup (TPUs prefer sorted lanes over random scatter). Each step
+  expands ``search_width`` best unvisited parents, gathers their fixed-
+  degree adjacency rows, scores them with one MXU einsum, and re-selects
+  the buffer. Data-dependent termination is replaced by a static iteration
+  count (SURVEY.md §7 hard part (c)).
+
+Supported metrics: L2Expanded, L2SqrtExpanded, InnerProduct.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import BinaryIO, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.errors import expects
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import DistanceType, resolve_metric
+from raft_tpu.ops.select_k import running_merge_unique, select_k, worst_value
+from raft_tpu.random.rng import as_key
+from raft_tpu.utils.graph import reverse_edges
+
+_SUPPORTED = (
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.InnerProduct,
+)
+
+IVF_PQ = "ivf_pq"
+NN_DESCENT = "nn_descent"
+
+
+@dataclasses.dataclass
+class CagraIndexParams:
+    """``cagra::index_params`` analog (``neighbors/cagra_types.hpp:62``)."""
+
+    intermediate_graph_degree: int = 128
+    graph_degree: int = 64
+    build_algo: str = NN_DESCENT
+    metric: DistanceType = DistanceType.L2Expanded
+    nn_descent_niter: int = 20
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class CagraSearchParams:
+    """``cagra::search_params`` analog (``neighbors/cagra_types.hpp:85``)."""
+
+    itopk_size: int = 64
+    search_width: int = 1
+    max_iterations: int = 0  # 0 = auto (search_plan.cuh:136 adjust)
+    seed: int = 0
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class CagraIndex:
+    """Fixed-degree graph + dataset (``cagra_types.hpp:142``)."""
+
+    dataset: jax.Array  # [n, d]
+    sqnorms: jax.Array  # [n] f32 (L2 metrics)
+    graph: jax.Array  # [n, graph_degree] i32
+    metric: DistanceType
+    size: int
+
+    def tree_flatten(self):
+        return (self.dataset, self.sqnorms, self.graph), (self.metric, self.size)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, metric=aux[0], size=aux[1])
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+    @property
+    def graph_degree(self) -> int:
+        return self.graph.shape[1]
+
+
+# ---------------------------------------------------------------------------
+# graph optimization (prune + reverse merge)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("kout",))
+def _detour_rerank_chunk(graph, chunk_ids, *, kout: int):
+    """Detour counts for a chunk of nodes + re-rank (``kern_prune``,
+    ``graph_core.cuh:130`` and the rank-ordered rebuild at ``:425-442``).
+
+    For node A with ranked neighbors G[A]: detour(A, b) =
+    #{a < b : G[A, b] ∈ G[G[A, a]]}. Edges are kept ordered by
+    (detour count, original rank), truncated to ``kout``.
+    """
+    kin = graph.shape[1]
+    rows = graph[chunk_ids]  # [c, kin]
+    two_hop = graph[rows]  # [c, kin, kin]
+
+    def body(a, counts):
+        # hit[c, b] = G[A, b] ∈ two_hop[A, a, :]
+        hit = jnp.any(two_hop[:, a, :, None] == rows[:, None, :], axis=1)
+        rank_mask = jnp.arange(kin) > a  # only edges ranked after a
+        return counts + (hit & rank_mask[None, :]).astype(jnp.int32)
+
+    counts = lax.fori_loop(0, kin, body, jnp.zeros(rows.shape, jnp.int32))
+    # invalid (padded) edges sort last; order by (detour, rank) via one
+    # composite-integer argsort
+    counts = jnp.where(rows < 0, kin + 1, counts)
+    key = counts * kin + jnp.arange(kin)[None, :]
+    order = jnp.argsort(key, axis=1)
+    return jnp.take_along_axis(rows, order[:, :kout], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("kout",))
+def _merge_reverse(fwd, rev, *, kout: int):
+    """Protected-head merge (``graph_core.cuh:525-555``): keep the first
+    ``kout/2`` forward edges, fill the tail with reverse edges then the
+    remaining forward edges, keep-first dedup, truncate to ``kout``."""
+    n = fwd.shape[0]
+    protected = kout // 2
+    cand = jnp.concatenate([fwd[:, :protected], rev, fwd[:, protected:]], axis=1)
+    m = cand.shape[1]
+    # keep-first dedup: sort by (id, position); a sorted entry is a dup if
+    # its predecessor holds the same id (an earlier position wins).
+    pos = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32), cand.shape)
+    # int32 composite requires n * (2*graph_degree) < 2^31; invalid ids all
+    # tie at INT32_MAX (stable argsort keeps their relative order).
+    composite = jnp.where(cand < 0, jnp.iinfo(jnp.int32).max, cand * m + pos)
+    order = jnp.argsort(composite, axis=1, stable=True)
+    ids_s = jnp.take_along_axis(cand, order, axis=1)
+    pos_s = jnp.take_along_axis(pos, order, axis=1)
+    prev = jnp.concatenate([jnp.full_like(ids_s[:, :1], -2), ids_s[:, :-1]], axis=1)
+    dup = (ids_s == prev) | (ids_s < 0)
+    # compact survivors back into original order, take first kout
+    key2 = jnp.where(dup, m + pos_s, pos_s)
+    order2 = jnp.argsort(key2, axis=1)
+    merged = jnp.take_along_axis(ids_s, order2[:, :kout], axis=1)
+    dup_k = jnp.take_along_axis(dup, order2[:, :kout], axis=1)
+    return jnp.where(dup_k, -1, merged)
+
+
+def optimize(knn_graph: jax.Array, graph_degree: int, node_chunk: int = 2048) -> jax.Array:
+    """Prune an intermediate kNN graph to a fixed-degree CAGRA graph
+    (``cagra::optimize``, ``cagra_build.cuh:263``)."""
+    knn_graph = jnp.asarray(knn_graph, jnp.int32)
+    n, kin = knn_graph.shape
+    kout = min(graph_degree, kin)
+    parts = []
+    for s in range(0, n, node_chunk):
+        ids = jnp.arange(s, min(s + node_chunk, n), dtype=jnp.int32)
+        parts.append(_detour_rerank_chunk(knn_graph, ids, kout=kout))
+    fwd = jnp.concatenate(parts, axis=0)
+    # reverse lists ordered by forward rank: the reference's k-major
+    # insertion order (kern_make_rev_graph, graph_core.cuh:480-500)
+    rev = reverse_edges(fwd, n, kout, order_by_rank=True)
+    return _merge_reverse(fwd, rev, kout=kout)
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def build(
+    dataset,
+    params: Optional[CagraIndexParams] = None,
+    res: Optional[Resources] = None,
+    **kwargs,
+) -> CagraIndex:
+    """Build the CAGRA index (``cagra::build``, ``cagra_build.cuh:293``):
+    intermediate kNN graph via NN-descent or IVF-PQ+refine, then
+    :func:`optimize`."""
+    res = ensure_resources(res)
+    if params is None:
+        params = CagraIndexParams(**kwargs)
+    metric = resolve_metric(params.metric)
+    expects(metric in _SUPPORTED, "CAGRA does not support metric %s", metric)
+    dataset = jnp.asarray(dataset)
+    expects(dataset.ndim == 2, "dataset must be [n_rows, dim]")
+    n, d = dataset.shape
+    kin = min(params.intermediate_graph_degree, n - 1)
+    kout = min(params.graph_degree, kin)
+
+    if params.build_algo == NN_DESCENT:
+        from raft_tpu.neighbors import nn_descent
+
+        out = nn_descent.build(
+            dataset,
+            nn_descent.NNDescentParams(
+                graph_degree=kin,
+                intermediate_graph_degree=min(max(kin + kin // 2, kin + 8), n - 1),
+                max_iterations=params.nn_descent_niter,
+                metric=metric,
+                seed=params.seed,
+            ),
+        )
+        knn_graph = out.graph
+    else:
+        expects(params.build_algo == IVF_PQ, "unknown build_algo %s", params.build_algo)
+        from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+        from raft_tpu.neighbors.refine import refine as refine_fn
+
+        # build_knn_graph via IVF-PQ search over the dataset itself + exact
+        # re-rank (cagra_build.cuh:47-146)
+        pq = ivf_pq_mod.build(
+            dataset,
+            ivf_pq_mod.IvfPqIndexParams(
+                n_lists=max(1, min(1024, n // 128)), metric=metric, seed=params.seed
+            ),
+        )
+        top = kin + 1
+        _, cand = ivf_pq_mod.search(
+            pq, dataset, min(2 * top, pq.size), n_probes=32, query_batch=4096
+        )
+        _, nbrs = refine_fn(dataset, dataset, cand, top, metric=metric)
+        nbrs = np.asarray(nbrs)
+        rows = np.arange(n)[:, None]
+        # drop self-edges, keep kin per row: stable argsort pushes the (at
+        # most one) self-edge per row to the end without a host loop
+        mask = nbrs != rows
+        pos = np.argsort(~mask, axis=1, kind="stable")[:, :kin]
+        knn = np.take_along_axis(nbrs, pos, axis=1).astype(np.int32)
+        knn = np.where(np.take_along_axis(mask, pos, axis=1), knn, -1)
+        knn_graph = jnp.asarray(knn)
+
+    graph = optimize(knn_graph, kout)
+    data_f32 = dataset.astype(jnp.float32)
+    sqnorms = jnp.sum(data_f32 * data_f32, axis=1)
+    return CagraIndex(dataset=dataset, sqnorms=sqnorms, graph=graph, metric=metric, size=n)
+
+
+def from_graph(dataset, graph, metric=DistanceType.L2Expanded) -> CagraIndex:
+    """Assemble an index from a pre-built graph (``cagra::index`` ctor from
+    existing dataset+graph views, ``cagra_types.hpp:253``)."""
+    dataset = jnp.asarray(dataset)
+    graph = jnp.asarray(graph, jnp.int32)
+    expects(dataset.shape[0] == graph.shape[0], "dataset/graph row mismatch")
+    data_f32 = dataset.astype(jnp.float32)
+    return CagraIndex(
+        dataset=dataset,
+        sqnorms=jnp.sum(data_f32 * data_f32, axis=1),
+        graph=graph,
+        metric=resolve_metric(metric),
+        size=dataset.shape[0],
+    )
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "itopk", "width", "iters", "metric", "has_filter"),
+)
+def _cagra_search_impl(
+    dataset,
+    sqnorms,
+    graph,
+    queries,
+    init_ids,
+    filter_bits,
+    *,
+    k: int,
+    itopk: int,
+    width: int,
+    iters: int,
+    metric: DistanceType,
+    has_filter: bool,
+):
+    nq, d = queries.shape
+    n, deg = graph.shape
+    qf = queries.astype(jnp.float32)
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.asarray(worst_value(jnp.float32, select_min), jnp.float32)
+    q_sqnorm = jnp.sum(qf * qf, axis=1)
+
+    def score(cand):  # cand: [nq, c] ids, -1 invalid
+        safe = jnp.clip(cand, 0, None)
+        vecs = dataset[safe].astype(jnp.float32)  # [nq, c, d]
+        # HIGHEST: single-pass bf16 MXU rounding visibly degrades beam
+        # ranking (measured ~6 recall points on TPU); these matmuls are tiny
+        # and HBM-bound, so full-precision passes cost ~nothing.
+        dots = jnp.einsum(
+            "qd,qcd->qc",
+            qf,
+            vecs,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )
+        if select_min:
+            dist = q_sqnorm[:, None] + sqnorms[safe] - 2.0 * dots
+            dist = jnp.maximum(dist, 0.0)
+        else:
+            dist = dots
+        return jnp.where(cand < 0, worst, dist)
+
+    # -- init: random seed candidates (search_plan random init) -------------
+    # The visited-flag lane through running_merge_unique is the sort-based
+    # stand-in for the CUDA visited hashmap + bitonic merge
+    # (search_single_cta_kernel-inl.cuh:97-200).
+    init_d = score(init_ids)
+    buf_v, buf_i, buf_f = running_merge_unique(
+        jnp.full((nq, itopk), worst, jnp.float32),
+        jnp.full((nq, itopk), -1, jnp.int32),
+        init_d,
+        init_ids,
+        select_min=select_min,
+        acc_flags=jnp.zeros((nq, itopk), bool),
+    )
+
+    def body(_, carry):
+        buf_v, buf_i, buf_f = carry
+        # pickup_next_parents (:54): best `width` unvisited entries
+        masked = jnp.where(buf_f | (buf_i < 0), worst, buf_v)
+        _, ppos = select_k(masked, width, select_min=select_min)
+        parents = jnp.take_along_axis(buf_i, ppos, axis=1)  # [nq, width]
+        pvalid = jnp.take_along_axis(masked, ppos, axis=1) != worst
+        parents = jnp.where(pvalid, parents, -1)
+        rows = jnp.arange(nq)[:, None]
+        buf_f = buf_f.at[rows, ppos].set(True)
+        # expand fixed-degree adjacency
+        nbrs = graph[jnp.clip(parents, 0, None)]  # [nq, width, deg]
+        nbrs = jnp.where(parents[:, :, None] >= 0, nbrs, -1).reshape(nq, width * deg)
+        dist = score(nbrs)
+        return running_merge_unique(
+            buf_v, buf_i, dist, nbrs, select_min=select_min, acc_flags=buf_f
+        )
+
+    buf_v, buf_i, buf_f = lax.fori_loop(0, iters, body, (buf_v, buf_i, buf_f))
+
+    if has_filter:
+        word = filter_bits[jnp.clip(buf_i, 0, None) // 32]
+        bit = (word >> (jnp.clip(buf_i, 0, None) % 32).astype(jnp.uint32)) & 1
+        keep = (buf_i >= 0) & (bit == 1)
+        buf_v = jnp.where(keep, buf_v, worst)
+        buf_i = jnp.where(keep, buf_i, -1)
+        buf_v, pos = select_k(buf_v, itopk, select_min=select_min)
+        buf_i = jnp.take_along_axis(buf_i, pos, axis=1)
+
+    vals, idx = buf_v[:, :k], buf_i[:, :k]
+    if metric == DistanceType.L2SqrtExpanded:
+        vals = jnp.where(idx >= 0, jnp.sqrt(jnp.maximum(vals, 0.0)), vals)
+    return vals, idx
+
+
+def search(
+    index: CagraIndex,
+    queries,
+    k: int,
+    params: Optional[CagraSearchParams] = None,
+    prefilter: Optional[Bitset] = None,
+    query_batch: int = 1024,
+    res: Optional[Resources] = None,
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy beam search over the graph (``cagra::search``,
+    ``detail/cagra/cagra_search.cuh:249``). Returns best-first
+    ``(distances [nq, k], indices [nq, k])``; unfilled slots get id -1."""
+    ensure_resources(res)
+    if params is None:
+        params = CagraSearchParams(**kwargs)
+    queries = jnp.asarray(queries)
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim, "bad query shape")
+    expects(k >= 1, "k must be >= 1")
+    itopk = max(params.itopk_size, k)
+    width = max(1, params.search_width)
+    # auto iteration count (search_plan.cuh:136 adjust_search_params)
+    iters = params.max_iterations or max(10, itopk // max(1, width))
+    if prefilter is not None:
+        expects(prefilter.size >= index.size, "prefilter smaller than index")
+    filter_bits = prefilter.bits if prefilter is not None else None
+
+    nq = queries.shape[0]
+    n_init = min(itopk, index.size)
+    key = as_key(params.seed)
+
+    out_v, out_i = [], []
+    for start in range(0, nq, query_batch):
+        qc = queries[start : start + query_batch]
+        bpad = 0
+        if qc.shape[0] < query_batch and nq > query_batch:
+            bpad = query_batch - qc.shape[0]
+            qc = jnp.pad(qc, ((0, bpad), (0, 0)))
+        key, kb = jax.random.split(key)
+        init_ids = jax.random.randint(kb, (qc.shape[0], n_init), 0, index.size, jnp.int32)
+        v, i = _cagra_search_impl(
+            index.dataset,
+            index.sqnorms,
+            index.graph,
+            qc,
+            init_ids,
+            filter_bits,
+            k=k,
+            itopk=itopk,
+            width=width,
+            iters=iters,
+            metric=index.metric,
+            has_filter=filter_bits is not None,
+        )
+        if bpad:
+            v, i = v[:-bpad], i[:-bpad]
+        out_v.append(v)
+        out_i.append(i)
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# serialization (neighbors/cagra_serialize.cuh analog)
+# ---------------------------------------------------------------------------
+
+_KIND = "cagra"
+_VERSION = 1
+
+
+def save(index: CagraIndex, stream: BinaryIO, include_dataset: bool = True) -> None:
+    ser.dump_header(stream, _KIND, _VERSION)
+    ser.serialize_scalar(stream, int(index.metric), "int32")
+    ser.serialize_scalar(stream, int(index.size), "int64")
+    ser.serialize_scalar(stream, int(include_dataset), "int32")
+    ser.serialize_array(stream, index.graph)
+    if include_dataset:
+        ser.serialize_array(stream, index.dataset)
+
+
+def load(stream: BinaryIO, dataset=None, res: Optional[Resources] = None) -> CagraIndex:
+    """Load an index; if it was saved without the dataset, one must be
+    supplied (mirrors the reference's dataset-less serialize mode,
+    ``cagra_serialize.cuh``)."""
+    ensure_resources(res)
+    ser.check_header(stream, _KIND)
+    metric = DistanceType(ser.deserialize_scalar(stream, "int32"))
+    size = int(ser.deserialize_scalar(stream, "int64"))
+    has_ds = bool(ser.deserialize_scalar(stream, "int32"))
+    graph = ser.deserialize_array(stream)
+    if has_ds:
+        data = ser.deserialize_array(stream)
+    else:
+        expects(dataset is not None, "index was saved without dataset; pass one")
+        data = jnp.asarray(dataset)
+    expects(data.shape[0] == size, "dataset rows != index size")
+    return from_graph(data, graph, metric)

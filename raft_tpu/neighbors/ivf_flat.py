@@ -291,7 +291,14 @@ def _ivf_search_impl(
         data_p = list_data[list_id]  # [nq, max_list, d] gather
         ids_p = list_indices[list_id]  # [nq, max_list]
         dots = jnp.einsum(
-            "qd,qmd->qm", qf, data_p.astype(jnp.float32), preferred_element_type=jnp.float32
+            "qd,qmd->qm",
+            qf,
+            data_p.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+            # full-precision passes: in-list ranking must match the exact
+            # distances the reference computes (see cagra.py note on the
+            # TPU default bf16 matmul)
+            precision=lax.Precision.HIGHEST,
         )
         if metric == DistanceType.InnerProduct:
             dist = dots

@@ -489,9 +489,9 @@ def _ivf_pq_search_impl(
             # score = q . c  +  sum_j q_sub[j] . pq_c[j, code_j]
             if per_cluster:
                 pqc = pq_centers[list_id]  # [nq, ksub, pq_len]
-                lut = jnp.einsum("npl,nkl->npk", q_sub, pqc, preferred_element_type=jnp.float32)
+                lut = jnp.einsum("npl,nkl->npk", q_sub, pqc, preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST)
             else:
-                lut = jnp.einsum("npl,pkl->npk", q_sub, pq_centers, preferred_element_type=jnp.float32)
+                lut = jnp.einsum("npl,pkl->npk", q_sub, pq_centers, preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST)
             base = jnp.take_along_axis(q_dot_c, list_id[:, None], axis=1)[:, 0]
         else:
             # dist = sum_j || (q_rot - c_rot)[j] - pq_c[j, code_j] ||^2
@@ -499,10 +499,10 @@ def _ivf_pq_search_impl(
             dn = jnp.sum(diff * diff, axis=-1)  # [nq, pq_dim]
             if per_cluster:
                 pqc = pq_centers[list_id]
-                dots = jnp.einsum("npl,nkl->npk", diff, pqc, preferred_element_type=jnp.float32)
+                dots = jnp.einsum("npl,nkl->npk", diff, pqc, preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST)
                 cn = pqc_norm[list_id][:, None, :]  # [nq, 1, ksub]
             else:
-                dots = jnp.einsum("npl,pkl->npk", diff, pq_centers, preferred_element_type=jnp.float32)
+                dots = jnp.einsum("npl,pkl->npk", diff, pq_centers, preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST)
                 cn = pqc_norm[None, :, :]
             lut = dn[:, :, None] - 2.0 * dots + cn  # [nq, pq_dim, ksub]
             base = jnp.float32(0.0)

@@ -95,6 +95,62 @@ def running_merge(
     return select_k(vals, k, select_min=select_min, indices=idx)
 
 
+def running_merge_unique(
+    acc_values: jax.Array,
+    acc_indices: jax.Array,
+    new_values: jax.Array,
+    new_indices: jax.Array,
+    select_min: bool = True,
+    acc_flags: Optional[jax.Array] = None,
+    new_flags: Optional[jax.Array] = None,
+):
+    """:func:`running_merge` with per-row id deduplication.
+
+    Graph-based searches (NN-descent local joins, CAGRA beam search) can
+    propose the same candidate id through several paths; a plain merge would
+    let one id occupy multiple top-k slots. Duplicates (same non-negative id
+    within a row) are invalidated before selection — the analog of the CUDA
+    visited-hashmap dedup (``detail/cagra/hashmap.hpp``), done as a sort +
+    adjacent-compare, which is the TPU-shaped substitute for random-access
+    hash probing. Assumes equal ids carry equal values (true when values are
+    deterministic distances). Negative ids are treated as invalid padding.
+
+    When ``acc_flags`` is given, a boolean flag lane (e.g. CAGRA's
+    "visited", GNND's "already sampled") rides along through the merge; on a
+    duplicate id the flagged (True) copy wins, and the return value gains a
+    third element. Requires ids < 2^30 (int32 composite sort key).
+    """
+    k = acc_values.shape[1]
+    vals = jnp.concatenate([acc_values, new_values], axis=1)
+    ids = jnp.concatenate([acc_indices, new_indices], axis=1)
+    worst = jnp.asarray(worst_value(vals.dtype, select_min), vals.dtype)
+    vals = jnp.where(ids < 0, worst, vals)
+    with_flags = acc_flags is not None
+    if with_flags:
+        if new_flags is None:
+            new_flags = jnp.zeros(new_indices.shape, bool)
+        flg = jnp.concatenate([acc_flags, new_flags], axis=1)
+        # sort by (id, flagged-first) so the flagged copy survives dedup
+        composite = ids * 2 + (1 - flg.astype(jnp.int32))
+        composite = jnp.where(ids < 0, jnp.iinfo(jnp.int32).max, composite)
+        order = jnp.argsort(composite, axis=1, stable=True)
+        flg_s = jnp.take_along_axis(flg, order, axis=1)
+    else:
+        order = jnp.argsort(ids, axis=1)
+    ids_s = jnp.take_along_axis(ids, order, axis=1)
+    vals_s = jnp.take_along_axis(vals, order, axis=1)
+    prev = jnp.concatenate([jnp.full_like(ids_s[:, :1], -2), ids_s[:, :-1]], axis=1)
+    dup = (ids_s == prev) & (ids_s >= 0)
+    vals_s = jnp.where(dup, worst, vals_s)
+    out_v, pos = select_k(vals_s, k, select_min=select_min)
+    out_i = jnp.take_along_axis(ids_s, pos, axis=1)
+    # Slots that selected a sentinel (all-invalid row tails) report id -1.
+    out_i = jnp.where(out_v == worst, -1, out_i)
+    if with_flags:
+        return out_v, out_i, jnp.take_along_axis(flg_s, pos, axis=1)
+    return out_v, out_i
+
+
 def worst_value(dtype, select_min: bool = True):
     """Sentinel used to pad candidate buffers (the reference uses
     ``upper_bound``/``lower_bound`` limits, ``select_warpsort.cuh``)."""

@@ -1,0 +1,42 @@
+"""Shared static-shape graph utilities for the neighbor-graph algorithms
+(NN-descent, CAGRA).
+
+The CUDA reference builds reverse adjacency by scattering into ragged
+per-node lists with atomics (``detail/cagra/graph_core.cuh``
+``kern_make_rev_graph``; the GNND reverse sampling in
+``detail/nn_descent.cuh``). The TPU-shaped equivalent below is a sort by
+destination + first-occurrence rank + bounded scatter — every shape static.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def reverse_edges(graph: jax.Array, n: int, r: int, order_by_rank: bool = False) -> jax.Array:
+    """Rank-limited reverse adjacency: for edges (u -> graph[u, j]) keep up
+    to ``r`` sources per destination, returned as ``[n, r]`` (-1 padded).
+
+    ``order_by_rank=True`` orders each reverse list by the edge's forward
+    rank ``j`` (the reference's k-major insertion order); otherwise edges
+    keep their flattened order. int32 composite sort keys require
+    ``n * graph.shape[1] < 2^31`` (n < ~16M at degree 128).
+    """
+    deg = graph.shape[1]
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), deg)
+    dst = graph.reshape(-1)
+    dst = jnp.where(dst < 0, n, dst)  # invalid edges sort to the end
+    if order_by_rank:
+        fwd_rank = jnp.tile(jnp.arange(deg, dtype=jnp.int32), n)
+        order = jnp.argsort(dst * deg + fwd_rank)
+    else:
+        order = jnp.argsort(dst)
+    dsts = dst[order]
+    srcs = src[order]
+    first = jnp.searchsorted(dsts, dsts, side="left")
+    rank = jnp.arange(n * deg, dtype=jnp.int32) - first.astype(jnp.int32)
+    keep = (rank < r) & (dsts < n)
+    rows = jnp.where(keep, dsts, n)  # out-of-bounds rows -> dropped
+    cols = jnp.where(keep, rank, 0)
+    rev = jnp.full((n, r), -1, jnp.int32)
+    return rev.at[rows, cols].set(srcs, mode="drop")

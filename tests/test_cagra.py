@@ -1,0 +1,157 @@
+"""CAGRA tests — recall-threshold vs exact kNN (reference pattern:
+``cpp/test/neighbors/ann_cagra.cuh``) plus unit checks on the graph
+optimizer (prune + reverse merge)."""
+import io
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, cagra
+from raft_tpu.neighbors.cagra import CagraIndexParams, CagraSearchParams
+from raft_tpu.ops.distance import DistanceType
+from raft_tpu.stats import neighborhood_recall
+
+
+def _data(rng, n, d, n_centers=16, scale=0.25):
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32)
+    labels = rng.integers(0, n_centers, n)
+    return (centers[labels] + scale * rng.standard_normal((n, d))).astype(np.float32)
+
+
+class TestOptimize:
+    def test_degree_and_validity(self, rng):
+        n, kin, kout = 500, 16, 8
+        # random well-formed knn graph (no self loops)
+        g = rng.integers(0, n - 1, (n, kin)).astype(np.int32)
+        g = g + (g >= np.arange(n)[:, None])
+        out = np.asarray(cagra.optimize(g, kout))
+        assert out.shape == (n, kout)
+        assert (out < n).all()
+        # no duplicate ids within a row (ignoring -1 pads)
+        for i in range(0, n, 37):
+            row = out[i][out[i] >= 0]
+            assert len(set(row.tolist())) == len(row)
+
+    def test_detour_pruning_prefers_no_detour_edges(self):
+        # Node 0's neighbors ranked [1, 2]; 1's list contains 2, so edge
+        # 0->2 has a detour via 1 and must be pruned when kout=1.
+        g = np.array(
+            [
+                [1, 2],
+                [2, 3],
+                [3, 0],
+                [0, 1],
+            ],
+            np.int32,
+        )
+        fwd = np.asarray(cagra._detour_rerank_chunk(g, np.arange(4, dtype=np.int32), kout=1))
+        assert fwd[0, 0] == 1  # rank-0 edge kept, detour edge 0->2 dropped
+
+    def test_reverse_merge_keeps_protected_head(self, rng):
+        n, kout = 200, 8
+        # rows must be duplicate-free (true of any real kNN graph)
+        g = np.empty((n, kout), np.int32)
+        for i in range(n):
+            choices = rng.permutation(n - 1)[:kout]
+            g[i] = choices + (choices >= i)
+        out = np.asarray(cagra.optimize(g, kout))
+        # reverse merge never disturbs the first kout/2 pruned-forward edges:
+        # recompute the pure-forward pruning and compare heads
+        fwd = np.asarray(
+            cagra._detour_rerank_chunk(g, np.arange(n, dtype=np.int32), kout=kout)
+        )
+        np.testing.assert_array_equal(out[:, : kout // 2], fwd[:, : kout // 2])
+
+
+class TestCagraSearch:
+    def test_recall_nn_descent_build(self, rng):
+        n, d, nq, k = 4000, 32, 64, 10
+        X = _data(rng, n, d)
+        Q = _data(rng, nq, d)
+        index = cagra.build(
+            X, CagraIndexParams(intermediate_graph_degree=48, graph_degree=24, seed=0)
+        )
+        _, ref = brute_force.search(brute_force.build(X), Q, k)
+        _, ann = cagra.search(index, Q, k, CagraSearchParams(itopk_size=64, search_width=2))
+        recall = float(neighborhood_recall(np.asarray(ann), np.asarray(ref)))
+        assert recall >= 0.9, f"recall {recall}"
+
+    def test_recall_ivf_pq_build(self, rng):
+        n, d, nq, k = 3000, 32, 48, 10
+        X = _data(rng, n, d)
+        Q = _data(rng, nq, d)
+        index = cagra.build(
+            X,
+            CagraIndexParams(
+                intermediate_graph_degree=32,
+                graph_degree=16,
+                build_algo=cagra.IVF_PQ,
+                seed=1,
+            ),
+        )
+        _, ref = brute_force.search(brute_force.build(X), Q, k)
+        _, ann = cagra.search(index, Q, k, CagraSearchParams(itopk_size=64, search_width=2))
+        recall = float(neighborhood_recall(np.asarray(ann), np.asarray(ref)))
+        assert recall >= 0.85, f"recall {recall}"
+
+    def test_inner_product(self, rng):
+        n, d, nq, k = 3000, 32, 48, 10
+        X = _data(rng, n, d)
+        X /= np.linalg.norm(X, axis=1, keepdims=True)
+        Q = _data(rng, nq, d)
+        index = cagra.build(
+            X,
+            CagraIndexParams(
+                intermediate_graph_degree=48,
+                graph_degree=24,
+                metric=DistanceType.InnerProduct,
+                seed=2,
+            ),
+        )
+        _, ref = brute_force.search(
+            brute_force.build(X, metric=DistanceType.InnerProduct), Q, k
+        )
+        _, ann = cagra.search(index, Q, k, CagraSearchParams(itopk_size=64, search_width=2))
+        recall = float(neighborhood_recall(np.asarray(ann), np.asarray(ref)))
+        assert recall >= 0.8, f"IP recall {recall}"
+
+    def test_prefilter(self, rng):
+        from raft_tpu.core.bitset import Bitset
+
+        n, d, nq, k = 2000, 16, 16, 5
+        X = _data(rng, n, d)
+        Q = _data(rng, nq, d)
+        index = cagra.build(
+            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=3)
+        )
+        banned = np.arange(0, n, 2, dtype=np.int32)
+        bs = Bitset.create(n, default=True).unset(banned)
+        _, idx = cagra.search(
+            index, Q, k, CagraSearchParams(itopk_size=64, search_width=2), prefilter=bs
+        )
+        idx = np.asarray(idx)
+        assert ((idx % 2 == 1) | (idx < 0)).all()
+
+    def test_from_graph_and_serialize(self, rng):
+        n, d, nq, k = 1500, 16, 16, 5
+        X = _data(rng, n, d)
+        Q = _data(rng, nq, d)
+        index = cagra.build(
+            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=4)
+        )
+        # round trip with dataset
+        buf = io.BytesIO()
+        cagra.save(index, buf)
+        buf.seek(0)
+        loaded = cagra.load(buf)
+        p = CagraSearchParams(itopk_size=32, seed=7)
+        v1, i1 = cagra.search(index, Q, k, p)
+        v2, i2 = cagra.search(loaded, Q, k, p)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        # round trip without dataset (graph-only file + external dataset)
+        buf2 = io.BytesIO()
+        cagra.save(index, buf2, include_dataset=False)
+        buf2.seek(0)
+        loaded2 = cagra.load(buf2, dataset=X)
+        v3, i3 = cagra.search(loaded2, Q, k, p)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i3))

@@ -1,0 +1,99 @@
+"""NN-descent tests — recall of the built kNN graph against the exact
+graph (reference pattern: ``cpp/test/neighbors/ann_nn_descent.cu`` asserts
+recall over a threshold)."""
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, nn_descent
+from raft_tpu.neighbors.nn_descent import NNDescentParams
+from raft_tpu.ops.distance import DistanceType
+from raft_tpu.stats import neighborhood_recall
+
+
+def _data(rng, n, d, n_centers=16, scale=0.25):
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32)
+    labels = rng.integers(0, n_centers, n)
+    return (centers[labels] + scale * rng.standard_normal((n, d))).astype(np.float32)
+
+
+def _exact_graph(X, k, metric=DistanceType.L2Expanded):
+    """Exact kNN graph excluding self-edges."""
+    idx = brute_force.build(X, metric=metric)
+    _, nbrs = brute_force.search(idx, X, k + 1)
+    nbrs = np.asarray(nbrs)
+    n = X.shape[0]
+    out = np.empty((n, k), np.int64)
+    for i in range(n):
+        row = nbrs[i][nbrs[i] != i]
+        out[i] = row[:k]
+    return out
+
+
+class TestNNDescent:
+    def test_graph_recall_l2(self, rng):
+        n, d, k = 2000, 32, 16
+        X = _data(rng, n, d)
+        out = nn_descent.build(
+            X,
+            NNDescentParams(
+                graph_degree=k, intermediate_graph_degree=32, max_iterations=12, seed=0
+            ),
+        )
+        assert out.graph.shape == (n, k)
+        ref = _exact_graph(X, k)
+        recall = float(neighborhood_recall(np.asarray(out.graph), ref))
+        assert recall >= 0.85, f"graph recall {recall}"
+
+    def test_no_self_loops_no_dups(self, rng):
+        n, d, k = 1000, 16, 8
+        X = _data(rng, n, d)
+        out = nn_descent.build(X, NNDescentParams(graph_degree=k, max_iterations=8, seed=1))
+        g = np.asarray(out.graph)
+        rows = np.arange(n)[:, None]
+        assert (g != rows).all(), "self-loop in graph"
+        for i in range(0, n, 97):
+            row = g[i][g[i] >= 0]
+            assert len(set(row.tolist())) == len(row), f"dup in row {i}"
+
+    def test_distances_sorted_and_correct(self, rng):
+        n, d, k = 800, 16, 8
+        X = _data(rng, n, d)
+        out = nn_descent.build(X, NNDescentParams(graph_degree=k, max_iterations=8, seed=2))
+        g = np.asarray(out.graph)
+        dv = np.asarray(out.distances)
+        assert (np.diff(dv, axis=1) >= -1e-4).all(), "distances not sorted"
+        # spot-check distance values
+        for i in range(0, n, 203):
+            for j in range(k):
+                if g[i, j] >= 0:
+                    exact = ((X[i] - X[g[i, j]]) ** 2).sum()
+                    np.testing.assert_allclose(dv[i, j], exact, rtol=1e-3, atol=1e-3)
+
+    def test_cosine(self, rng):
+        n, d, k = 1000, 16, 8
+        X = _data(rng, n, d)
+        out = nn_descent.build(
+            X,
+            NNDescentParams(
+                graph_degree=k, metric=DistanceType.CosineExpanded, max_iterations=10, seed=3
+            ),
+        )
+        ref = _exact_graph(X, k, metric=DistanceType.CosineExpanded)
+        recall = float(neighborhood_recall(np.asarray(out.graph), ref))
+        assert recall >= 0.8, f"cosine graph recall {recall}"
+        # distances are 1 - cos in [0, 2]
+        dv = np.asarray(out.distances)
+        assert (dv[np.asarray(out.graph) >= 0] >= -1e-5).all()
+        assert (dv[np.asarray(out.graph) >= 0] <= 2.0 + 1e-5).all()
+
+    def test_early_termination(self, rng):
+        # with a loose threshold, build must still return a valid graph
+        n, d, k = 600, 8, 4
+        X = _data(rng, n, d)
+        out = nn_descent.build(
+            X,
+            NNDescentParams(
+                graph_degree=k, max_iterations=50, termination_threshold=0.05, seed=4
+            ),
+        )
+        assert (np.asarray(out.graph) >= 0).all()
