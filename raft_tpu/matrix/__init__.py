@@ -1,0 +1,42 @@
+"""Matrix ops layer (L4 analog) — ``raft/matrix`` surface.
+
+See ``SURVEY.md`` §2.3 (``/root/reference/cpp/include/raft/matrix``);
+``select_k`` lives in :mod:`raft_tpu.ops.select_k` and is re-exported here
+for API parity.
+"""
+from raft_tpu.matrix.ops import (
+    argmax,
+    argmin,
+    col_wise_sort,
+    diagonal,
+    gather,
+    gather_if,
+    linewise_op,
+    matrix_slice,
+    reverse,
+    sample_rows,
+    scatter,
+    sign_flip,
+    threshold,
+    triangular_upper,
+)
+from raft_tpu.ops.select_k import merge_parts, select_k
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "col_wise_sort",
+    "diagonal",
+    "gather",
+    "gather_if",
+    "linewise_op",
+    "matrix_slice",
+    "merge_parts",
+    "reverse",
+    "sample_rows",
+    "scatter",
+    "select_k",
+    "sign_flip",
+    "threshold",
+    "triangular_upper",
+]

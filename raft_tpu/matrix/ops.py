@@ -1,0 +1,112 @@
+"""``raft::matrix`` analog — gather/scatter, slicing, row/col ops.
+
+Reference: ``matrix/{gather,scatter,slice,argmax,argmin,col_wise_sort,
+diagonal,linewise_op,reverse,sample_rows,sign_flip,threshold,triangular}.cuh``.
+Each is an XLA-fused one-liner on TPU; the module exists for API parity and
+shape checking.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+
+
+def gather(matrix, indices) -> jax.Array:
+    """Row gather (``matrix/gather.cuh``): out[i] = matrix[indices[i]]."""
+    m = jnp.asarray(matrix)
+    idx = jnp.asarray(indices, jnp.int32)
+    expects(m.ndim == 2 and idx.ndim == 1, "gather expects matrix + 1-D indices")
+    return m[idx]
+
+
+def gather_if(matrix, indices, stencil, pred: Callable, fill=0) -> jax.Array:
+    """Conditional row gather (``matrix/gather.cuh`` gather_if): rows whose
+    stencil fails ``pred`` are filled."""
+    out = gather(matrix, indices)
+    keep = pred(jnp.asarray(stencil))
+    return jnp.where(keep[:, None], out, fill)
+
+
+def scatter(matrix, indices, updates) -> jax.Array:
+    """Row scatter (``matrix/scatter.cuh``): out[indices[i]] = updates[i]."""
+    m = jnp.asarray(matrix)
+    idx = jnp.asarray(indices, jnp.int32)
+    return m.at[idx].set(jnp.asarray(updates, m.dtype))
+
+
+def matrix_slice(matrix, row0: int, col0: int, row1: int, col1: int) -> jax.Array:
+    """Submatrix copy (``matrix/slice.cuh``): [row0:row1, col0:col1]."""
+    m = jnp.asarray(matrix)
+    expects(0 <= row0 < row1 <= m.shape[0], "bad row slice")
+    expects(0 <= col0 < col1 <= m.shape[1], "bad col slice")
+    return m[row0:row1, col0:col1]
+
+
+def argmax(matrix) -> jax.Array:
+    """Per-row argmax (``matrix/argmax.cuh``)."""
+    return jnp.argmax(jnp.asarray(matrix), axis=1).astype(jnp.int32)
+
+
+def argmin(matrix) -> jax.Array:
+    """Per-row argmin (``matrix/argmin.cuh``)."""
+    return jnp.argmin(jnp.asarray(matrix), axis=1).astype(jnp.int32)
+
+
+def col_wise_sort(matrix, ascending: bool = True) -> jax.Array:
+    """Sort each column (``matrix/col_wise_sort.cuh``)."""
+    m = jnp.asarray(matrix)
+    out = jnp.sort(m, axis=0)
+    return out if ascending else out[::-1]
+
+
+def diagonal(matrix) -> jax.Array:
+    """``matrix/diagonal.cuh``."""
+    return jnp.diagonal(jnp.asarray(matrix))
+
+
+def linewise_op(matrix, vec, op: Callable, along_lines: bool = True) -> jax.Array:
+    """``matrix/linewise_op.cuh``: apply op(matrix_element, vec_element)
+    broadcasting ``vec`` along rows (True) or columns."""
+    m = jnp.asarray(matrix)
+    v = jnp.asarray(vec)
+    return op(m, v[None, :] if along_lines else v[:, None])
+
+
+def reverse(matrix, along_rows: bool = False) -> jax.Array:
+    """``matrix/reverse.cuh``: flip column order (or row order)."""
+    m = jnp.asarray(matrix)
+    return m[::-1] if along_rows else m[:, ::-1]
+
+
+def sample_rows(key, matrix, n_samples: int) -> jax.Array:
+    """Uniform row subsample without replacement
+    (``matrix/sample_rows.cuh``)."""
+    from raft_tpu.random.rng import as_key
+
+    m = jnp.asarray(matrix)
+    expects(0 < n_samples <= m.shape[0], "n_samples out of range")
+    idx = jax.random.permutation(as_key(key), m.shape[0])[:n_samples]
+    return m[idx]
+
+
+def sign_flip(matrix) -> jax.Array:
+    """``matrix/sign_flip.cuh``: flip each column's sign so its
+    largest-|.| element is positive (canonical eigenvector orientation)."""
+    m = jnp.asarray(matrix)
+    pivot = jnp.take_along_axis(m, jnp.argmax(jnp.abs(m), axis=0)[None, :], axis=0)[0]
+    return m * jnp.where(pivot < 0, -1.0, 1.0)[None, :]
+
+
+def threshold(matrix, value: float, fill: float = 0.0) -> jax.Array:
+    """Zero entries below ``value`` (``matrix/threshold.cuh``)."""
+    m = jnp.asarray(matrix)
+    return jnp.where(m < value, fill, m)
+
+
+def triangular_upper(matrix) -> jax.Array:
+    """Upper-triangular copy (``matrix/triangular.cuh``)."""
+    return jnp.triu(jnp.asarray(matrix))

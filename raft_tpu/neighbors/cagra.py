@@ -327,7 +327,15 @@ def _cagra_search_impl(
             dist = jnp.maximum(dist, 0.0)
         else:
             dist = dots
-        return jnp.where(cand < 0, worst, dist)
+        invalid = cand < 0
+        if has_filter:
+            # filter at insertion (the reference's sample-filter hook inside
+            # the search kernel): banned ids never occupy buffer slots, so
+            # valid candidates keep competing even under selective filters
+            word = filter_bits[jnp.clip(cand, 0, None) // 32]
+            bit = (word >> (jnp.clip(cand, 0, None) % 32).astype(jnp.uint32)) & 1
+            invalid = invalid | (bit == 0)
+        return jnp.where(invalid, worst, dist)
 
     # -- init: random seed candidates (search_plan random init) -------------
     # The visited-flag lane through running_merge_unique is the sort-based
@@ -362,15 +370,6 @@ def _cagra_search_impl(
         )
 
     buf_v, buf_i, buf_f = lax.fori_loop(0, iters, body, (buf_v, buf_i, buf_f))
-
-    if has_filter:
-        word = filter_bits[jnp.clip(buf_i, 0, None) // 32]
-        bit = (word >> (jnp.clip(buf_i, 0, None) % 32).astype(jnp.uint32)) & 1
-        keep = (buf_i >= 0) & (bit == 1)
-        buf_v = jnp.where(keep, buf_v, worst)
-        buf_i = jnp.where(keep, buf_i, -1)
-        buf_v, pos = select_k(buf_v, itopk, select_min=select_min)
-        buf_i = jnp.take_along_axis(buf_i, pos, axis=1)
 
     vals, idx = buf_v[:, :k], buf_i[:, :k]
     if metric == DistanceType.L2SqrtExpanded:

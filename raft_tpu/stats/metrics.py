@@ -1,0 +1,241 @@
+"""Model metrics — analog of ``raft/stats/{accuracy,r2_score,
+regression_metrics,contingency_matrix,adjusted_rand_index,rand_index,
+entropy,mutual_info_score,homogeneity_score,completeness_score,v_measure,
+kl_divergence,silhouette_score,dispersion,information_criterion,
+trustworthiness_score}.cuh``.
+
+Label-pair metrics route through one contingency matrix built as a
+segment-sum scatter (``stats/detail/contingencyMatrix.cuh`` builds the same
+table with atomics); everything downstream is a handful of VPU reductions.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+from raft_tpu.ops.distance import DistanceType, pairwise_distance
+
+
+def accuracy(predictions, ref_predictions) -> jax.Array:
+    """``raft::stats::accuracy`` (``stats/accuracy.cuh``)."""
+    p = jnp.asarray(predictions)
+    r = jnp.asarray(ref_predictions)
+    expects(p.shape == r.shape, "shape mismatch")
+    return jnp.mean((p == r).astype(jnp.float32))
+
+
+def r2_score(y, y_hat) -> jax.Array:
+    """``raft::stats::r2_score`` (``stats/r2_score.cuh``)."""
+    y = jnp.asarray(y, jnp.float32)
+    y_hat = jnp.asarray(y_hat, jnp.float32)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return 1.0 - ss_res / ss_tot
+
+
+def regression_metrics(predictions, ref) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(mean_abs_error, mean_squared_error, median_abs_error)
+    (``stats/regression_metrics.cuh``)."""
+    p = jnp.asarray(predictions, jnp.float32)
+    r = jnp.asarray(ref, jnp.float32)
+    err = jnp.abs(p - r)
+    return jnp.mean(err), jnp.mean(err * err), jnp.median(err)
+
+
+def contingency_matrix(y_true, y_pred, n_classes: Optional[int] = None) -> jax.Array:
+    """[n_classes, n_classes] label co-occurrence counts
+    (``stats/contingency_matrix.cuh``). Labels must be in [0, n_classes)."""
+    t = jnp.asarray(y_true, jnp.int32)
+    p = jnp.asarray(y_pred, jnp.int32)
+    expects(t.shape == p.shape and t.ndim == 1, "labels must be matching 1-D")
+    if n_classes is None:
+        n_classes = int(jnp.maximum(jnp.max(t), jnp.max(p))) + 1
+    flat = t * n_classes + p
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(flat, jnp.float32), flat, num_segments=n_classes * n_classes
+    )
+    return counts.reshape(n_classes, n_classes)
+
+
+def rand_index(y_true, y_pred) -> jax.Array:
+    """``raft::stats::rand_index`` (``stats/rand_index.cuh``)."""
+    c = contingency_matrix(y_true, y_pred)
+    n = jnp.sum(c)
+    sum_comb_c = jnp.sum(c * (c - 1)) / 2.0
+    a = jnp.sum(c, axis=1)
+    b = jnp.sum(c, axis=0)
+    sum_comb_a = jnp.sum(a * (a - 1)) / 2.0
+    sum_comb_b = jnp.sum(b * (b - 1)) / 2.0
+    total = n * (n - 1) / 2.0
+    agree = sum_comb_c + (total - sum_comb_a - sum_comb_b + sum_comb_c)
+    return agree / total
+
+
+def adjusted_rand_index(y_true, y_pred) -> jax.Array:
+    """``raft::stats::adjusted_rand_index``
+    (``stats/adjusted_rand_index.cuh``)."""
+    c = contingency_matrix(y_true, y_pred)
+    n = jnp.sum(c)
+    sum_comb = jnp.sum(c * (c - 1)) / 2.0
+    a = jnp.sum(c, axis=1)
+    b = jnp.sum(c, axis=0)
+    comb_a = jnp.sum(a * (a - 1)) / 2.0
+    comb_b = jnp.sum(b * (b - 1)) / 2.0
+    total = n * (n - 1) / 2.0
+    expected = comb_a * comb_b / jnp.maximum(total, 1.0)
+    max_index = 0.5 * (comb_a + comb_b)
+    return (sum_comb - expected) / jnp.maximum(max_index - expected, 1e-30)
+
+
+def entropy(labels, n_classes: Optional[int] = None) -> jax.Array:
+    """Shannon entropy of a label vector in nats
+    (``stats/entropy.cuh``)."""
+    y = jnp.asarray(labels, jnp.int32)
+    if n_classes is None:
+        n_classes = int(jnp.max(y)) + 1
+    counts = jax.ops.segment_sum(jnp.ones_like(y, jnp.float32), y, num_segments=n_classes)
+    p = counts / jnp.maximum(jnp.sum(counts), 1.0)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0))
+
+
+def mutual_info_score(y_true, y_pred, n_classes: Optional[int] = None) -> jax.Array:
+    """``raft::stats::mutual_info_score`` (``stats/mutual_info_score.cuh``)."""
+    c = contingency_matrix(y_true, y_pred, n_classes)
+    n = jnp.maximum(jnp.sum(c), 1.0)
+    pij = c / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    denom = pi * pj
+    ratio = jnp.where((pij > 0) & (denom > 0), pij / jnp.where(denom > 0, denom, 1.0), 1.0)
+    return jnp.sum(jnp.where(pij > 0, pij * jnp.log(ratio), 0.0))
+
+
+def homogeneity_score(y_true, y_pred, n_classes: Optional[int] = None) -> jax.Array:
+    """``raft::stats::homogeneity_score``
+    (``stats/homogeneity_score.cuh``): MI / H(true)."""
+    mi = mutual_info_score(y_true, y_pred, n_classes)
+    h = entropy(y_true, n_classes)
+    return jnp.where(h == 0, 1.0, mi / jnp.where(h == 0, 1.0, h))
+
+
+def completeness_score(y_true, y_pred, n_classes: Optional[int] = None) -> jax.Array:
+    """``raft::stats::completeness_score``
+    (``stats/completeness_score.cuh``): MI / H(pred)."""
+    mi = mutual_info_score(y_true, y_pred, n_classes)
+    h = entropy(y_pred, n_classes)
+    return jnp.where(h == 0, 1.0, mi / jnp.where(h == 0, 1.0, h))
+
+
+def v_measure(y_true, y_pred, n_classes: Optional[int] = None, beta: float = 1.0) -> jax.Array:
+    """``raft::stats::v_measure`` (``stats/v_measure.cuh``)."""
+    h = homogeneity_score(y_true, y_pred, n_classes)
+    c = completeness_score(y_true, y_pred, n_classes)
+    denom = beta * h + c
+    return jnp.where(denom == 0, 0.0, (1.0 + beta) * h * c / jnp.where(denom == 0, 1.0, denom))
+
+
+def kl_divergence(p, q) -> jax.Array:
+    """``raft::stats::kl_divergence`` (``stats/kl_divergence.cuh``)."""
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    ratio = jnp.where((p > 0) & (q > 0), p / jnp.where(q > 0, q, 1.0), 1.0)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(ratio), 0.0))
+
+
+def silhouette_score(X, labels, n_clusters: Optional[int] = None, chunk: int = 2048) -> jax.Array:
+    """Mean silhouette coefficient (``stats/silhouette_score.cuh``; the
+    batched variant mirrors ``batched_silhouette_score``): per-sample
+    (b - a) / max(a, b) using mean intra/inter-cluster distances, computed
+    from chunked pairwise distances + a cluster-sum matmul."""
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(labels, jnp.int32)
+    n = X.shape[0]
+    if n_clusters is None:
+        n_clusters = int(jnp.max(y)) + 1
+    onehot = jax.nn.one_hot(y, n_clusters, dtype=jnp.float32)  # [n, k]
+    counts = jnp.sum(onehot, axis=0)  # [k]
+
+    scores = []
+    for s in range(0, n, chunk):
+        xc = X[s : s + chunk]
+        yc = y[s : s + chunk]
+        d = pairwise_distance(xc, X, DistanceType.L2SqrtExpanded)  # [c, n]
+        sums = d @ onehot  # [c, k] total distance to each cluster
+        own = counts[yc]  # [c]
+        row = jnp.arange(xc.shape[0])
+        a = sums[row, yc] / jnp.maximum(own - 1.0, 1.0)
+        mean_other = sums / jnp.maximum(counts[None, :], 1.0)
+        mean_other = mean_other.at[row, yc].set(jnp.inf)
+        b = jnp.min(mean_other, axis=1)
+        sil = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+        scores.append(sil)
+    return jnp.mean(jnp.concatenate(scores))
+
+
+def dispersion(centroids, cluster_sizes, global_centroid=None) -> jax.Array:
+    """Between-cluster dispersion (``stats/dispersion.cuh``): sqrt of the
+    size-weighted squared distances of centroids to the global centroid."""
+    c = jnp.asarray(centroids, jnp.float32)
+    sizes = jnp.asarray(cluster_sizes, jnp.float32)
+    if global_centroid is None:
+        global_centroid = jnp.sum(c * sizes[:, None], axis=0) / jnp.maximum(jnp.sum(sizes), 1.0)
+    d2 = jnp.sum((c - global_centroid[None, :]) ** 2, axis=1)
+    return jnp.sqrt(jnp.sum(sizes * d2))
+
+
+class CriterionType(enum.IntEnum):
+    """``batched::linalg::detail::ic_type`` analog
+    (``stats/information_criterion.cuh``)."""
+
+    AIC = 0
+    AICc = 1
+    BIC = 2
+
+
+def information_criterion(
+    log_likelihood, criterion: CriterionType, n_params: int, n_samples: int
+) -> jax.Array:
+    """``raft::stats::information_criterion_batched``
+    (``stats/information_criterion.cuh``)."""
+    ll = jnp.asarray(log_likelihood, jnp.float32)
+    base = -2.0 * ll
+    if criterion == CriterionType.AIC:
+        return base + 2.0 * n_params
+    if criterion == CriterionType.AICc:
+        corr = 2.0 * n_params * (n_params + 1) / max(n_samples - n_params - 1, 1)
+        return base + 2.0 * n_params + corr
+    return base + n_params * jnp.log(jnp.float32(n_samples))
+
+
+def trustworthiness_score(X, X_embedded, n_neighbors: int = 5, chunk: int = 2048) -> jax.Array:
+    """Embedding trustworthiness (``stats/trustworthiness_score.cuh``):
+    penalizes embedded-space neighbors that are far in the original space."""
+    from raft_tpu.ops.select_k import select_k
+
+    X = jnp.asarray(X, jnp.float32)
+    E = jnp.asarray(X_embedded, jnp.float32)
+    n = X.shape[0]
+    k = n_neighbors
+    expects(k < n, "n_neighbors must be < n_samples")
+
+    penalties = []
+    for s in range(0, n, chunk):
+        d_orig = pairwise_distance(X[s : s + chunk], X, DistanceType.L2Expanded)
+        d_emb = pairwise_distance(E[s : s + chunk], E, DistanceType.L2Expanded)
+        row = jnp.arange(d_orig.shape[0])
+        # rank of every sample in original space (0 = self)
+        orig_order = jnp.argsort(d_orig, axis=1)
+        ranks = jnp.zeros_like(orig_order).at[row[:, None], orig_order].set(
+            jnp.broadcast_to(jnp.arange(n), orig_order.shape)
+        )
+        d_emb = d_emb.at[row, s + row].set(jnp.inf)  # exclude self
+        _, nbrs = select_k(d_emb, k, select_min=True)
+        r = jnp.take_along_axis(ranks, nbrs, axis=1)  # original-space ranks
+        penalties.append(jnp.sum(jnp.maximum(r - k, 0).astype(jnp.float32)))
+    t = jnp.sum(jnp.stack(penalties))
+    norm = 2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0))
+    return 1.0 - norm * t

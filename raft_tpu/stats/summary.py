@@ -1,0 +1,110 @@
+"""Summary statistics — analog of ``raft/stats/{mean,stddev,sum,cov,
+minmax,histogram,meanvar,weighted_mean,mean_center}.cuh``.
+
+Thin, shape-checked jnp compositions: on TPU these are single fused VPU
+reductions; the value added over raw jnp is the reference's API surface
+(row/col orientation flags, sample vs population semantics) and jit-safety.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+
+
+def _axis(along_rows: bool) -> int:
+    # along_rows=True reduces over the row axis (per-column stats), matching
+    # the reference's rowMajor/alongRows conventions.
+    return 0 if along_rows else 1
+
+
+def mean(x, along_rows: bool = True) -> jax.Array:
+    """``raft::stats::mean`` (``stats/mean.cuh``)."""
+    return jnp.mean(jnp.asarray(x, jnp.float32), axis=_axis(along_rows))
+
+
+def sum_(x, along_rows: bool = True) -> jax.Array:
+    """``raft::stats::sum`` (``stats/sum.cuh``)."""
+    return jnp.sum(jnp.asarray(x, jnp.float32), axis=_axis(along_rows))
+
+
+def stddev(x, sample: bool = False, along_rows: bool = True) -> jax.Array:
+    """``raft::stats::stddev`` (``stats/stddev.cuh``); ``sample`` selects
+    the n-1 denominator."""
+    x = jnp.asarray(x, jnp.float32)
+    return jnp.std(x, axis=_axis(along_rows), ddof=1 if sample else 0)
+
+
+def meanvar(x, sample: bool = False, along_rows: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """``raft::stats::meanvar`` (``stats/meanvar.cuh``)."""
+    x = jnp.asarray(x, jnp.float32)
+    ax = _axis(along_rows)
+    return jnp.mean(x, axis=ax), jnp.var(x, axis=ax, ddof=1 if sample else 0)
+
+
+def mean_center(x, mu: Optional[jax.Array] = None, along_rows: bool = True) -> jax.Array:
+    """``raft::stats::mean_center`` (``stats/mean_center.cuh``)."""
+    x = jnp.asarray(x, jnp.float32)
+    if mu is None:
+        mu = mean(x, along_rows)
+    return x - (mu[None, :] if along_rows else mu[:, None])
+
+
+def mean_add(x, mu: jax.Array, along_rows: bool = True) -> jax.Array:
+    """``raft::stats::mean_add`` (``stats/mean_center.cuh``)."""
+    x = jnp.asarray(x, jnp.float32)
+    return x + (mu[None, :] if along_rows else mu[:, None])
+
+
+def cov(x, mu: Optional[jax.Array] = None, sample: bool = True, stable: bool = True) -> jax.Array:
+    """Covariance of columns (``raft::stats::cov``, ``stats/cov.cuh``):
+    [d, d] from [n, d] data. ``sample`` selects the n-1 denominator;
+    ``stable=False`` uses the reference's single-pass
+    ``E[xxᵀ] - n·μμᵀ`` form (one fewer pass, more cancellation error)."""
+    x = jnp.asarray(x, jnp.float32)
+    expects(x.ndim == 2, "cov expects [n, d]")
+    n = x.shape[0]
+    if mu is None:
+        mu = jnp.mean(x, axis=0)
+    denom = max(n - 1, 1) if sample else n
+    if stable:
+        xc = x - mu[None, :]
+        return (xc.T @ xc) / denom
+    return (x.T @ x - n * jnp.outer(mu, mu)) / denom
+
+
+def weighted_mean(x, weights, along_rows: bool = True) -> jax.Array:
+    """``raft::stats::weighted_mean`` (``stats/weighted_mean.cuh``)."""
+    x = jnp.asarray(x, jnp.float32)
+    w = jnp.asarray(weights, jnp.float32)
+    ax = _axis(along_rows)
+    wb = w[:, None] if ax == 0 else w[None, :]
+    return jnp.sum(x * wb, axis=ax) / jnp.maximum(jnp.sum(w), 1e-30)
+
+
+def minmax(x, along_rows: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """``raft::stats::minmax`` (``stats/minmax.cuh``)."""
+    x = jnp.asarray(x)
+    ax = _axis(along_rows)
+    return jnp.min(x, axis=ax), jnp.max(x, axis=ax)
+
+
+def histogram(x, n_bins: int, lower: float, upper: float) -> jax.Array:
+    """Fixed-width histogram per column (``raft::stats::histogram``,
+    ``stats/histogram.cuh`` HistTypeAuto semantics): [n_bins, d] counts."""
+    x = jnp.asarray(x, jnp.float32)
+    expects(x.ndim == 2, "histogram expects [n, d]")
+    expects(upper > lower, "upper must exceed lower")
+    d = x.shape[1]
+    width = (upper - lower) / n_bins
+    bins = jnp.clip(((x - lower) / width).astype(jnp.int32), 0, n_bins - 1)
+    inside = (x >= lower) & (x < upper)
+    # scatter-add into d*n_bins segments — O(n*d) work, no dense one-hot
+    flat = (bins + jnp.arange(d, dtype=jnp.int32)[None, :] * n_bins).reshape(-1)
+    counts = jax.ops.segment_sum(
+        inside.reshape(-1).astype(jnp.int32), flat, num_segments=d * n_bins
+    )
+    return counts.reshape(d, n_bins).T  # [n_bins, d]

@@ -132,6 +132,28 @@ class TestCagraSearch:
         idx = np.asarray(idx)
         assert ((idx % 2 == 1) | (idx < 0)).all()
 
+    def test_selective_prefilter_still_returns_k(self, rng):
+        # 95% of ids banned: insertion-time filtering must keep valid
+        # candidates competing for buffer slots (post-hoc filtering would
+        # return mostly -1 here)
+        from raft_tpu.core.bitset import Bitset
+
+        n, d, nq, k = 2000, 16, 16, 5
+        X = _data(rng, n, d)
+        Q = _data(rng, nq, d)
+        index = cagra.build(
+            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=5)
+        )
+        allowed = np.arange(0, n, 20, dtype=np.int32)  # 5% allowed
+        bs = Bitset.create(n, default=False).set(allowed)
+        _, idx = cagra.search(
+            index, Q, k, CagraSearchParams(itopk_size=64, search_width=4), prefilter=bs
+        )
+        idx = np.asarray(idx)
+        assert (idx % 20 == 0).all() or ((idx < 0) | (idx % 20 == 0)).all()
+        # most slots should actually be filled with allowed ids
+        assert (idx >= 0).mean() >= 0.8
+
     def test_from_graph_and_serialize(self, rng):
         n, d, nq, k = 1500, 16, 16, 5
         X = _data(rng, n, d)

@@ -1,0 +1,192 @@
+"""linalg + matrix tests vs numpy (reference pattern:
+``cpp/test/linalg/*``, ``cpp/test/matrix/*``)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import linalg, matrix
+from raft_tpu.linalg.ops import NormType
+
+
+class TestLinalgBlas:
+    def test_gemm_gemv(self, rng):
+        a = rng.standard_normal((8, 5)).astype(np.float32)
+        b = rng.standard_normal((5, 7)).astype(np.float32)
+        c = rng.standard_normal((8, 7)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.gemm(a, b)), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(linalg.gemm(a, b, alpha=2.0, beta=0.5, c=c)), 2 * a @ b + 0.5 * c, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.gemm(b, a, trans_a=True, trans_b=True)), (a @ b).T, rtol=1e-5
+        )
+        x = rng.standard_normal(5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.gemv(a, x)), a @ x, rtol=1e-5)
+        np.testing.assert_allclose(float(linalg.dot(x, x)), x @ x, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(linalg.axpy(2.0, x, x)), 3 * x, rtol=1e-6)
+
+    def test_elementwise(self, rng):
+        x = rng.standard_normal((4, 4)).astype(np.float32)
+        y = rng.standard_normal((4, 4)).astype(np.float32) + 3.0
+        np.testing.assert_allclose(np.asarray(linalg.add(x, y)), x + y)
+        np.testing.assert_allclose(np.asarray(linalg.subtract(x, y)), x - y)
+        np.testing.assert_allclose(np.asarray(linalg.divide(x, y)), x / y, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(linalg.eltwise_multiply(x, y)), x * y)
+        np.testing.assert_allclose(np.asarray(linalg.multiply_scalar(x, 2.5)), 2.5 * x)
+        np.testing.assert_allclose(np.asarray(linalg.sqrt(np.abs(x))), np.sqrt(np.abs(x)))
+        np.testing.assert_allclose(
+            np.asarray(linalg.unary_op(x, lambda v: v * v)), x * x
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.ternary_op(x, y, x, lambda a, b, c: a + b * c)), x + y * x, rtol=1e-6
+        )
+
+    def test_map_reduce_scalar(self, rng):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        out = linalg.map_reduce(lambda a: a * a, jnp.add, x)
+        assert np.asarray(out).shape == ()
+        np.testing.assert_allclose(float(out), 30.0)
+        out_max = linalg.map_reduce(lambda a: -a, jnp.maximum, x, init=-np.inf)
+        np.testing.assert_allclose(float(out_max), -1.0)
+
+    def test_reductions(self, rng):
+        x = rng.standard_normal((6, 9)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.reduce_(x)), x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(linalg.reduce_(x, along_rows=True)), x.sum(0), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.reduce_(x, main_op=jnp.abs, final_op=jnp.sqrt)),
+            np.sqrt(np.abs(x).sum(1)),
+            rtol=1e-5,
+        )
+        keys = rng.integers(0, 3, 6)
+        out = np.asarray(linalg.reduce_rows_by_key(x, keys, 3))
+        for g in range(3):
+            np.testing.assert_allclose(out[g], x[keys == g].sum(0), rtol=1e-5, atol=1e-6)
+        ckeys = rng.integers(0, 4, 9)
+        outc = np.asarray(linalg.reduce_cols_by_key(x, ckeys, 4))
+        for g in range(4):
+            np.testing.assert_allclose(outc[:, g], x[:, ckeys == g].sum(1), rtol=1e-5, atol=1e-6)
+
+    def test_norms_normalize(self, rng):
+        x = rng.standard_normal((5, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(x, NormType.L1Norm)), np.abs(x).sum(1), rtol=1e-5
+        )
+        # reference semantics: L2 is squared unless sqrt requested
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(x, NormType.L2Norm)), (x * x).sum(1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(x, NormType.L2Norm, sqrt_out=True)),
+            np.linalg.norm(x, axis=1),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(x, NormType.LinfNorm)), np.abs(x).max(1), rtol=1e-6
+        )
+        nrm = np.asarray(linalg.normalize(x))
+        np.testing.assert_allclose(np.linalg.norm(nrm, axis=1), 1.0, rtol=1e-5)
+
+    def test_matrix_vector_op_mse(self, rng):
+        m = rng.standard_normal((4, 6)).astype(np.float32)
+        v = rng.standard_normal(6).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.matrix_vector_op(m, v)), m + v[None, :])
+        v2 = rng.standard_normal(4).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.matrix_vector_op(m, v2, jnp.multiply, along_rows=False)),
+            m * v2[:, None],
+        )
+        a = rng.standard_normal(32).astype(np.float32)
+        b = rng.standard_normal(32).astype(np.float32)
+        np.testing.assert_allclose(
+            float(linalg.mean_squared_error(a, b)), ((a - b) ** 2).mean(), rtol=1e-5
+        )
+
+
+class TestDecompositions:
+    def test_eig_dc(self, rng):
+        a = rng.standard_normal((6, 6)).astype(np.float32)
+        s = a @ a.T + 6 * np.eye(6, dtype=np.float32)
+        w, v = linalg.eig_dc(s)
+        w, v = np.asarray(w), np.asarray(v)
+        np.testing.assert_allclose(s @ v, v * w[None, :], atol=1e-3)
+        assert (np.diff(w) >= -1e-5).all()
+
+    def test_svd_qr_cholesky_lstsq(self, rng):
+        a = rng.standard_normal((8, 5)).astype(np.float32)
+        u, s, v = linalg.svd(a)
+        np.testing.assert_allclose(
+            np.asarray(u) * np.asarray(s)[None, :] @ np.asarray(v).T, a, atol=1e-4
+        )
+        q, r = linalg.qr(a)
+        np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a, atol=1e-4)
+        spd = a.T @ a + np.eye(5, dtype=np.float32)
+        c = np.asarray(linalg.cholesky(spd))
+        np.testing.assert_allclose(c @ c.T, spd, atol=1e-4)
+        b = rng.standard_normal(8).astype(np.float32)
+        sol = np.asarray(linalg.lstsq(a, b))
+        ref = np.linalg.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(sol, ref, atol=1e-3)
+
+    def test_rsvd(self, rng):
+        # low-rank matrix: rsvd must recover the spectrum accurately
+        u = np.linalg.qr(rng.standard_normal((60, 5)))[0].astype(np.float32)
+        v = np.linalg.qr(rng.standard_normal((40, 5)))[0].astype(np.float32)
+        s = np.array([10, 8, 5, 2, 1], np.float32)
+        a = (u * s[None, :]) @ v.T
+        ur, sr, vr = linalg.rsvd(a, 5, key=0)
+        np.testing.assert_allclose(np.asarray(sr), s, rtol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(ur) * np.asarray(sr)[None, :] @ np.asarray(vr).T, a, atol=1e-3
+        )
+
+
+class TestMatrixOps:
+    def test_gather_scatter_slice(self, rng):
+        m = rng.standard_normal((10, 4)).astype(np.float32)
+        idx = np.array([3, 1, 7], np.int32)
+        np.testing.assert_array_equal(np.asarray(matrix.gather(m, idx)), m[idx])
+        upd = rng.standard_normal((3, 4)).astype(np.float32)
+        out = np.asarray(matrix.scatter(m, idx, upd))
+        np.testing.assert_array_equal(out[idx], upd)
+        np.testing.assert_array_equal(np.asarray(matrix.matrix_slice(m, 2, 1, 5, 3)), m[2:5, 1:3])
+        g = np.asarray(
+            matrix.gather_if(m, idx, np.array([1, 0, 1]), lambda s: s > 0, fill=-1.0)
+        )
+        np.testing.assert_array_equal(g[0], m[3])
+        assert (g[1] == -1.0).all()
+
+    def test_argmax_argmin_sort(self, rng):
+        m = rng.standard_normal((6, 8)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.argmax(m)), m.argmax(1))
+        np.testing.assert_array_equal(np.asarray(matrix.argmin(m)), m.argmin(1))
+        np.testing.assert_array_equal(np.asarray(matrix.col_wise_sort(m)), np.sort(m, axis=0))
+
+    def test_linewise_reverse_diag(self, rng):
+        m = rng.standard_normal((4, 6)).astype(np.float32)
+        v = rng.standard_normal(6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(matrix.linewise_op(m, v, jnp.multiply)), m * v[None, :]
+        )
+        np.testing.assert_array_equal(np.asarray(matrix.reverse(m)), m[:, ::-1])
+        np.testing.assert_array_equal(np.asarray(matrix.reverse(m, along_rows=True)), m[::-1])
+        sq = rng.standard_normal((5, 5)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.diagonal(sq)), np.diagonal(sq))
+
+    def test_sample_sign_threshold_triangular(self, rng):
+        m = rng.standard_normal((20, 3)).astype(np.float32)
+        s = np.asarray(matrix.sample_rows(0, m, 5))
+        assert s.shape == (5, 3)
+        # every sampled row exists in m
+        for row in s:
+            assert (np.abs(m - row[None, :]).sum(1) < 1e-6).any()
+        flipped = np.asarray(matrix.sign_flip(m))
+        piv = np.abs(flipped).argmax(0)
+        assert (flipped[piv, np.arange(3)] >= 0).all()
+        th = np.asarray(matrix.threshold(m, 0.5))
+        assert ((th == 0) | (th >= 0.5)).all()
+        sq = rng.standard_normal((4, 4)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.triangular_upper(sq)), np.triu(sq))

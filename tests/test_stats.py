@@ -1,0 +1,178 @@
+"""Stats tests — validated against numpy / closed-form references
+(reference pattern: ``cpp/test/stats/*`` compares against host math)."""
+import numpy as np
+import pytest
+
+from raft_tpu import stats
+from raft_tpu.stats.metrics import CriterionType
+
+
+class TestSummary:
+    def test_mean_stddev_sum(self, rng):
+        x = rng.standard_normal((100, 8)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(stats.mean(x)), x.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(stats.mean(x, along_rows=False)), x.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(stats.sum_(x)), x.sum(0), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(stats.stddev(x)), x.std(0), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(stats.stddev(x, sample=True)), x.std(0, ddof=1), rtol=1e-4
+        )
+
+    def test_meanvar_center(self, rng):
+        x = rng.standard_normal((50, 4)).astype(np.float32)
+        m, v = stats.meanvar(x, sample=True)
+        np.testing.assert_allclose(np.asarray(v), x.var(0, ddof=1), rtol=1e-4)
+        centered = np.asarray(stats.mean_center(x))
+        np.testing.assert_allclose(centered.mean(0), 0.0, atol=1e-5)
+        restored = np.asarray(stats.mean_add(centered, m))
+        np.testing.assert_allclose(restored, x, atol=1e-5)
+
+    def test_cov(self, rng):
+        x = rng.standard_normal((200, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(stats.cov(x)), np.cov(x, rowvar=False), rtol=1e-3, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats.cov(x, stable=False)), np.cov(x, rowvar=False), rtol=1e-3, atol=1e-3
+        )
+
+    def test_weighted_mean(self, rng):
+        x = rng.standard_normal((30, 3)).astype(np.float32)
+        w = rng.random(30).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(stats.weighted_mean(x, w)),
+            (x * w[:, None]).sum(0) / w.sum(),
+            rtol=1e-4,
+        )
+
+    def test_minmax_histogram(self, rng):
+        x = rng.standard_normal((500, 2)).astype(np.float32)
+        lo, hi = stats.minmax(x)
+        np.testing.assert_allclose(np.asarray(lo), x.min(0))
+        np.testing.assert_allclose(np.asarray(hi), x.max(0))
+        h = np.asarray(stats.histogram(x, 10, -3.0, 3.0))
+        assert h.shape == (10, 2)
+        for c in range(2):
+            ref, _ = np.histogram(x[:, c], bins=10, range=(-3.0, 3.0))
+            inside = (x[:, c] >= -3) & (x[:, c] < 3)
+            # np.histogram includes the right edge in the last bin; ours is
+            # half-open — compare on interior bins
+            np.testing.assert_array_equal(h[:-1, c], ref[:-1])
+            assert h[:, c].sum() == inside.sum()
+
+
+class TestClassificationRegression:
+    def test_accuracy_r2(self, rng):
+        y = rng.integers(0, 4, 100)
+        p = y.copy()
+        p[:20] = (p[:20] + 1) % 4
+        assert abs(float(stats.accuracy(p, y)) - 0.8) < 1e-6
+        yt = rng.standard_normal(100).astype(np.float32)
+        yp = yt + 0.1 * rng.standard_normal(100).astype(np.float32)
+        ss_res = ((yt - yp) ** 2).sum()
+        ss_tot = ((yt - yt.mean()) ** 2).sum()
+        np.testing.assert_allclose(float(stats.r2_score(yt, yp)), 1 - ss_res / ss_tot, rtol=1e-4)
+
+    def test_regression_metrics(self, rng):
+        a = rng.standard_normal(64).astype(np.float32)
+        b = rng.standard_normal(64).astype(np.float32)
+        mae, mse, mdae = stats.regression_metrics(a, b)
+        np.testing.assert_allclose(float(mae), np.abs(a - b).mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(mse), ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(mdae), np.median(np.abs(a - b)), rtol=1e-5)
+
+
+class TestClusteringMetrics:
+    def test_contingency_and_rand(self, rng):
+        y1 = rng.integers(0, 3, 200)
+        y2 = rng.integers(0, 3, 200)
+        c = np.asarray(stats.contingency_matrix(y1, y2, 3))
+        assert c.sum() == 200
+        for i in range(3):
+            for j in range(3):
+                assert c[i, j] == ((y1 == i) & (y2 == j)).sum()
+        # perfect agreement
+        assert abs(float(stats.rand_index(y1, y1)) - 1.0) < 1e-6
+        assert abs(float(stats.adjusted_rand_index(y1, y1)) - 1.0) < 1e-6
+
+    def test_ari_matches_sklearn_formula(self, rng):
+        try:
+            from sklearn.metrics import adjusted_rand_score
+        except ImportError:
+            pytest.skip("sklearn unavailable")
+        y1 = rng.integers(0, 4, 300)
+        y2 = (y1 + (rng.random(300) < 0.3).astype(int)) % 4
+        np.testing.assert_allclose(
+            float(stats.adjusted_rand_index(y1, y2)), adjusted_rand_score(y1, y2), rtol=1e-4
+        )
+
+    def test_entropy_mi_vmeasure(self, rng):
+        try:
+            from sklearn.metrics import (
+                completeness_score,
+                homogeneity_score,
+                mutual_info_score,
+                v_measure_score,
+            )
+        except ImportError:
+            pytest.skip("sklearn unavailable")
+        y1 = rng.integers(0, 3, 200)
+        y2 = rng.integers(0, 4, 200)
+        np.testing.assert_allclose(
+            float(stats.mutual_info_score(y1, y2, 4)), mutual_info_score(y1, y2), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(stats.homogeneity_score(y1, y2, 4)), homogeneity_score(y1, y2), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(stats.completeness_score(y1, y2, 4)), completeness_score(y1, y2), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(stats.v_measure(y1, y2, 4)), v_measure_score(y1, y2), atol=1e-5
+        )
+        # uniform 4-class entropy == ln 4
+        y = np.repeat(np.arange(4), 25)
+        np.testing.assert_allclose(float(stats.entropy(y)), np.log(4), atol=1e-5)
+
+    def test_kl_divergence(self):
+        p = np.array([0.5, 0.5, 0.0], np.float32)
+        q = np.array([0.25, 0.5, 0.25], np.float32)
+        expected = 0.5 * np.log(0.5 / 0.25)
+        np.testing.assert_allclose(float(stats.kl_divergence(p, q)), expected, rtol=1e-5)
+
+    def test_silhouette(self, rng):
+        try:
+            from sklearn.metrics import silhouette_score as sk_sil
+        except ImportError:
+            pytest.skip("sklearn unavailable")
+        centers = np.array([[0, 0], [10, 10], [0, 10]], np.float32)
+        y = rng.integers(0, 3, 150)
+        X = centers[y] + 0.5 * rng.standard_normal((150, 2)).astype(np.float32)
+        np.testing.assert_allclose(
+            float(stats.silhouette_score(X, y, 3)), sk_sil(X, y), atol=1e-3
+        )
+
+    def test_dispersion(self, rng):
+        c = rng.standard_normal((4, 3)).astype(np.float32)
+        sizes = np.array([10, 20, 30, 40], np.float32)
+        g = (c * sizes[:, None]).sum(0) / sizes.sum()
+        expected = np.sqrt((sizes * ((c - g) ** 2).sum(1)).sum())
+        np.testing.assert_allclose(float(stats.dispersion(c, sizes)), expected, rtol=1e-5)
+
+    def test_information_criterion(self):
+        ll = np.array([-100.0], np.float32)
+        aic = float(stats.information_criterion(ll, CriterionType.AIC, 5, 50)[0])
+        bic = float(stats.information_criterion(ll, CriterionType.BIC, 5, 50)[0])
+        np.testing.assert_allclose(aic, 210.0)
+        np.testing.assert_allclose(bic, 200.0 + 5 * np.log(50), rtol=1e-6)
+
+    def test_trustworthiness(self, rng):
+        try:
+            from sklearn.manifold import trustworthiness as sk_trust
+        except ImportError:
+            pytest.skip("sklearn unavailable")
+        X = rng.standard_normal((120, 8)).astype(np.float32)
+        E = X[:, :2] + 0.01 * rng.standard_normal((120, 2)).astype(np.float32)
+        ours = float(stats.trustworthiness_score(X, E, n_neighbors=5))
+        ref = sk_trust(X, E, n_neighbors=5)
+        np.testing.assert_allclose(ours, ref, atol=1e-3)
