@@ -6,6 +6,7 @@ See ``SURVEY.md`` §2.4 (``/root/reference/cpp/include/raft/cluster``).
 from raft_tpu.cluster import kmeans, kmeans_balanced
 from raft_tpu.cluster.kmeans import KMeansOutput, KMeansParams
 from raft_tpu.cluster.kmeans_balanced import BalancedKMeansParams
+from raft_tpu.cluster.single_linkage import SingleLinkageOutput, single_linkage
 
 __all__ = [
     "kmeans",
@@ -13,4 +14,6 @@ __all__ = [
     "KMeansOutput",
     "KMeansParams",
     "BalancedKMeansParams",
+    "SingleLinkageOutput",
+    "single_linkage",
 ]

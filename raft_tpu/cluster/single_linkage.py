@@ -1,0 +1,141 @@
+"""Single-linkage agglomerative clustering — analog of
+``raft::cluster::single_linkage`` (``cluster/single_linkage.cuh``,
+``cluster/detail/{connectivities,mst,agglomerative}.cuh``).
+
+Pipeline (same as the reference): kNN-graph connectivities → MST (with
+cross-component connection fix-up when the kNN graph is disconnected) →
+dendrogram by merging MST edges in weight order → flat labels by cutting
+the dendrogram at ``n_clusters``.
+
+The MST runs on device (vectorized Borůvka, :mod:`raft_tpu.sparse.solver`);
+the dendrogram build is an inherently sequential union-find over n-1 edges
+and runs on host at build time (the reference does the same,
+``agglomerative.cuh`` builds the dendrogram on host).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.errors import expects
+from raft_tpu.ops.distance import DistanceType, resolve_metric
+from raft_tpu.sparse.neighbors import cross_component_nn, knn_graph
+from raft_tpu.sparse.solver import mst
+from raft_tpu.sparse.types import COO
+
+
+@dataclasses.dataclass
+class SingleLinkageOutput:
+    """``linkage_output`` analog (``cluster/single_linkage_types.hpp``)."""
+
+    labels: np.ndarray  # [n] flat cluster labels
+    children: np.ndarray  # [n-1, 2] merged node ids (scipy linkage style)
+    deltas: np.ndarray  # [n-1] merge distances
+    sizes: np.ndarray  # [n-1] merged cluster sizes
+    n_clusters: int
+
+
+class _UnionFind:
+    def __init__(self, n):
+        self.parent = np.arange(n)
+
+    def find(self, x):
+        root = x
+        while self.parent[root] != root:
+            root = self.parent[root]
+        while self.parent[x] != root:
+            self.parent[x], x = root, self.parent[x]
+        return root
+
+    def union(self, a, b):
+        ra, rb = self.find(a), self.find(b)
+        if ra == rb:
+            return False
+        self.parent[rb] = ra
+        return True
+
+
+def _components(n, src, dst):
+    uf = _UnionFind(n)
+    for a, b in zip(src, dst):
+        uf.union(int(a), int(b))
+    roots = np.array([uf.find(i) for i in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+    return labels, len(np.unique(roots))
+
+
+def single_linkage(
+    X,
+    n_clusters: int = 2,
+    c: int = 15,
+    metric=DistanceType.L2SqrtExpanded,
+) -> SingleLinkageOutput:
+    """Fit single-linkage clustering (``single_linkage.cuh:60``); ``c``
+    controls kNN-graph connectivity (k = min(c, n-1), the reference's
+    ``c`` knob)."""
+    metric = resolve_metric(metric)
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    expects(1 <= n_clusters <= n, "n_clusters out of range")
+    k = min(max(c, 2), n - 1)
+
+    g = knn_graph(X, k, metric=metric)
+    res = mst(g)
+    src, dst, w = res.src, res.dst, res.weights
+
+    # connect components until spanning (connect_components +
+    # cross_component_nn fix-up, detail/connectivities.cuh)
+    for _ in range(64):
+        labels, n_comp = _components(n, src, dst)
+        if n_comp == 1:
+            break
+        cs, cd, cw = cross_component_nn(X, labels, n_comp, metric=metric)
+        extra = COO(
+            jnp.asarray(np.concatenate([src, cs]), jnp.int32),
+            jnp.asarray(np.concatenate([dst, cd]), jnp.int32),
+            jnp.asarray(np.concatenate([w, cw]), jnp.float32),
+            (n, n),
+        )
+        res = mst(extra)
+        src, dst, w = res.src, res.dst, res.weights
+
+    expects(len(w) == n - 1, "failed to build spanning tree")
+
+    # -- dendrogram: merge edges in weight order (agglomerative.cuh) --------
+    order = np.argsort(w, kind="stable")
+    src_o, dst_o, w_o = src[order], dst[order], w[order]
+    uf = _UnionFind(2 * n - 1)
+    cluster_of = np.arange(n)  # current dendrogram node of each root
+    sizes_acc = np.ones(2 * n - 1, np.int64)
+    children = np.empty((n - 1, 2), np.int64)
+    deltas = np.empty(n - 1, np.float64)
+    sizes = np.empty(n - 1, np.int64)
+    nxt = n
+    for i in range(n - 1):
+        ra, rb = uf.find(int(src_o[i])), uf.find(int(dst_o[i]))
+        ca, cb = cluster_of[ra], cluster_of[rb]
+        children[i] = (ca, cb)
+        deltas[i] = w_o[i]
+        sizes[i] = sizes_acc[ca] + sizes_acc[cb]
+        sizes_acc[nxt] = sizes[i]
+        uf.union(ra, rb)
+        cluster_of[uf.find(ra)] = nxt
+        nxt += 1
+
+    # -- flat labels: cut the last (n_clusters - 1) merges ------------------
+    uf2 = _UnionFind(n)
+    for i in range(n - 1 - (n_clusters - 1)):
+        uf2.union(int(src_o[i]), int(dst_o[i]))
+    roots = np.array([uf2.find(i) for i in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+
+    return SingleLinkageOutput(
+        labels=labels.astype(np.int32),
+        children=children,
+        deltas=deltas,
+        sizes=sizes,
+        n_clusters=n_clusters,
+    )

@@ -1,0 +1,8 @@
+"""Label utilities — analog of ``raft/label``.
+
+See ``SURVEY.md`` §2.4 (``label/classlabels.cuh``,
+``label/merge_labels.cuh``).
+"""
+from raft_tpu.label.classlabels import get_classes, make_monotonic, merge_labels
+
+__all__ = ["get_classes", "make_monotonic", "merge_labels"]

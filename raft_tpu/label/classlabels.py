@@ -1,0 +1,67 @@
+"""``raft::label`` analog.
+
+Reference: ``label/classlabels.cuh`` (``getUniquelabels``,
+``make_monotonic``) and ``label/merge_labels.cuh`` (label equivalence
+merging via iterated min-propagation, used by connected-components style
+algorithms).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+
+
+def get_classes(labels) -> jax.Array:
+    """Sorted unique labels (``getUniquelabels``, ``classlabels.cuh``)."""
+    return jnp.unique(jnp.asarray(labels))
+
+
+def make_monotonic(labels, zero_based: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Relabel to consecutive integers preserving order
+    (``make_monotonic``, ``classlabels.cuh``). Returns (new_labels,
+    classes) where ``classes[new] = old``."""
+    y = jnp.asarray(labels)
+    classes, inv = jnp.unique(y, return_inverse=True)
+    out = inv.astype(jnp.int32)
+    if not zero_based:
+        out = out + 1
+    return out, classes
+
+
+def merge_labels(labels_a, labels_b, mask=None, n_iters: int = 0) -> jax.Array:
+    """Merge two labelings into their finest common coarsening
+    (``merge_labels.cuh``): points sharing a label in EITHER input end in
+    the same output group; each group takes its minimum ``labels_a`` value.
+
+    Implemented as iterated min-propagation through both label spaces (the
+    reference kernel does the same fixed-point with atomicMin); ``mask``
+    restricts which points participate in ``labels_b`` groups (the
+    reference's core-point mask).
+    """
+    a = jnp.asarray(labels_a, jnp.int32)
+    b = jnp.asarray(labels_b, jnp.int32)
+    expects(a.shape == b.shape and a.ndim == 1, "labels must be matching 1-D")
+    n = a.shape[0]
+    m = jnp.ones((n,), bool) if mask is None else jnp.asarray(mask, bool)
+    na = int(jnp.max(a)) + 1
+    nb = int(jnp.max(b)) + 1
+    iters = n_iters or max(2, int(jnp.ceil(jnp.log2(jnp.float32(max(n, 2))))) + 1)
+
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+    out = a
+
+    def body(_, out):
+        # group minimum over a-groups (all points)
+        min_a = jax.ops.segment_min(out, a, num_segments=na)
+        out = min_a[a]
+        # group minimum over b-groups (masked points only)
+        masked_out = jnp.where(m, out, big)
+        min_b = jax.ops.segment_min(masked_out, b, num_segments=nb)
+        prop = jnp.minimum(out, min_b[b])
+        return jnp.where(m, prop, out)
+
+    return jax.lax.fori_loop(0, iters, body, out)

@@ -1,0 +1,7 @@
+"""Solver layer — analog of ``raft/solver``.
+
+See ``SURVEY.md`` §2.4 (``solver/linear_assignment.cuh``).
+"""
+from raft_tpu.solver.lap import lap_solve
+
+__all__ = ["lap_solve"]

@@ -1,0 +1,73 @@
+"""Linear assignment problem solver — analog of
+``raft::solver::LinearAssignmentProblem``
+(``solver/linear_assignment.cuh``, the Date–Nagi GPU Hungarian variant).
+
+Host-side shortest-augmenting-path (Jonker–Volgenant) implementation: the
+reference's consumers solve modest-sized assignment problems (cluster
+matching, tracking) at build/evaluation time, where an O(n³) host solve is
+the right tool on a TPU system (no warp-level frontier expansion to map).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from raft_tpu.core.errors import expects
+
+
+def lap_solve(cost) -> Tuple[np.ndarray, np.ndarray, float]:
+    """Solve min-cost perfect assignment on a square cost matrix.
+
+    Returns (row_assignment, col_assignment, total_cost) where
+    ``row_assignment[i]`` is the column assigned to row i (the reference's
+    ``getRowAssignments``/``getColAssignments``/``getPrimalObjectiveValue``
+    surface).
+    """
+    c = np.asarray(cost, np.float64)
+    expects(c.ndim == 2 and c.shape[0] == c.shape[1], "cost must be square")
+    n = c.shape[0]
+
+    INF = np.inf
+    u = np.zeros(n + 1)  # row potentials (1-indexed)
+    v = np.zeros(n + 1)  # col potentials
+    p = np.zeros(n + 1, np.int64)  # p[j] = row assigned to col j
+    way = np.zeros(n + 1, np.int64)
+
+    for i in range(1, n + 1):
+        p[0] = i
+        j0 = 0
+        minv = np.full(n + 1, INF)
+        used = np.zeros(n + 1, bool)
+        while True:
+            used[j0] = True
+            i0 = p[j0]
+            # vectorized relaxation over unused columns
+            cols = np.nonzero(~used)[0]
+            cur = c[i0 - 1, cols - 1] - u[i0] - v[cols]
+            better = cur < minv[cols]
+            minv[cols] = np.where(better, cur, minv[cols])
+            way[cols[better]] = j0
+            j1 = cols[np.argmin(minv[cols])]
+            delta = minv[j1]
+            # dual update (vectorized over the used/unused partitions)
+            used_idx = np.nonzero(used)[0]
+            u[p[used_idx]] += delta
+            v[used_idx] -= delta
+            minv[cols] -= delta
+            j0 = j1
+            if p[j0] == 0:
+                break
+        # augment along the alternating path
+        while j0:
+            j1 = way[j0]
+            p[j0] = p[j1]
+            j0 = j1
+
+    row_assign = np.zeros(n, np.int64)
+    for j in range(1, n + 1):
+        if p[j] > 0:
+            row_assign[p[j] - 1] = j - 1
+    col_assign = np.argsort(row_assign)
+    total = float(c[np.arange(n), row_assign].sum())
+    return row_assign.astype(np.int32), col_assign.astype(np.int32), total

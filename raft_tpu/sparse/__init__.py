@@ -1,0 +1,27 @@
+"""Sparse layer (L4 analog): COO/CSR containers, conversions, sparse
+linalg (spmm/sddmm/transpose/degree/norm/symmetrize), sparse pairwise
+distances + kNN, kNN-graph construction, MST and Lanczos solvers.
+
+See ``SURVEY.md`` §2.3 (``/root/reference/cpp/include/raft/sparse``).
+"""
+from raft_tpu.sparse import linalg
+from raft_tpu.sparse.distance import knn_sparse, pairwise_distance_sparse
+from raft_tpu.sparse.neighbors import cross_component_nn, knn_graph
+from raft_tpu.sparse.solver import MSTResult, lanczos, mst
+from raft_tpu.sparse.types import COO, CSR, coo_from_dense, coo_to_csr, csr_from_dense
+
+__all__ = [
+    "COO",
+    "CSR",
+    "MSTResult",
+    "coo_from_dense",
+    "coo_to_csr",
+    "cross_component_nn",
+    "csr_from_dense",
+    "knn_graph",
+    "knn_sparse",
+    "lanczos",
+    "linalg",
+    "mst",
+    "pairwise_distance_sparse",
+]

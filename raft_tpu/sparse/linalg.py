@@ -1,0 +1,125 @@
+"""Sparse linear algebra — analog of ``raft/sparse/linalg/{spmm,sddmm,
+transpose,degree,norm,symmetrize,add}.cuh`` (cusparse-backed in the
+reference).
+
+TPU-first: SpMM/SpMV are gather + segment-sum (XLA scatter-add) over the
+static nnz axis; SDDMM is a row/col gather + lane dot. Dense outputs ride
+the VPU; there is no cusparse to wrap and none needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+from raft_tpu.sparse.types import COO, CSR, coo_to_csr
+
+
+def spmv(a: CSR, x) -> jax.Array:
+    """CSR @ vector."""
+    x = jnp.asarray(x)
+    expects(x.shape == (a.shape[1],), "spmv shape mismatch")
+    rows = a.row_ids()
+    contrib = a.vals * x[a.indices]
+    return jax.ops.segment_sum(contrib, rows, num_segments=a.shape[0])
+
+
+def spmm(a: CSR, b) -> jax.Array:
+    """CSR @ dense  (``sparse/linalg/spmm.hpp``): per-nnz gather of B rows
+    scaled by vals, segment-summed by output row."""
+    b = jnp.asarray(b)
+    expects(b.ndim == 2 and b.shape[0] == a.shape[1], "spmm shape mismatch")
+    rows = a.row_ids()
+    contrib = a.vals[:, None] * b[a.indices]  # [nnz, k]
+    return jax.ops.segment_sum(contrib, rows, num_segments=a.shape[0])
+
+
+def sddmm(a, b, mask: COO, alpha: float = 1.0, beta: float = 0.0) -> COO:
+    """Sampled dense-dense matmul (``sparse/linalg/sddmm.hpp``):
+    out[i,j] = alpha * (A @ B)[i,j] + beta * mask[i,j], only at mask nnz."""
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    expects(a.shape[1] == b.shape[0], "sddmm inner dim mismatch")
+    dots = jnp.sum(a[mask.rows] * b.T[mask.cols], axis=1)
+    vals = alpha * dots + beta * mask.vals
+    return COO(mask.rows, mask.cols, vals, mask.shape)
+
+
+def transpose(a: CSR) -> CSR:
+    """``sparse/linalg/transpose.cuh``: swap roles + re-sort (one argsort)."""
+    coo = a.to_coo()
+    t = COO(coo.cols, coo.rows, coo.vals, (a.shape[1], a.shape[0]))
+    return coo_to_csr(t)
+
+
+def degree(coo: COO) -> jax.Array:
+    """Row degrees (``sparse/linalg/degree.cuh``)."""
+    return jax.ops.segment_sum(
+        jnp.ones((coo.nnz,), jnp.int32), coo.rows, num_segments=coo.shape[0]
+    )
+
+
+def row_norm_csr(a: CSR, norm_type: str = "l2") -> jax.Array:
+    """``sparse/linalg/norm.cuh`` rowNormCsr."""
+    rows = a.row_ids()
+    if norm_type == "l1":
+        contrib = jnp.abs(a.vals)
+    elif norm_type == "l2":
+        contrib = a.vals * a.vals
+    elif norm_type == "linf":
+        return jax.ops.segment_max(jnp.abs(a.vals), rows, num_segments=a.shape[0])
+    else:
+        raise ValueError(f"unknown norm {norm_type}")
+    return jax.ops.segment_sum(contrib, rows, num_segments=a.shape[0])
+
+
+def symmetrize(coo: COO, op: str = "max") -> COO:
+    """Graph symmetrization (``sparse/linalg/symmetrize.cuh``): combine
+    A and Aᵀ entrywise with ``op`` ("max" keeps an edge if either direction
+    has it; "mean" averages, with a missing direction counting as 0).
+
+    Duplicate (i, j) entries in the input are coalesced by summation first
+    (standard COO semantics, matching :meth:`COO.to_dense`). Static output
+    nnz = 2x input; each distinct (i, j) carries the combined value on its
+    first occurrence, later copies are zeroed. Sorting uses ``lexsort`` on
+    (row, col) — no composite integer key, so no n² overflow.
+    """
+    expects(coo.shape[0] == coo.shape[1], "symmetrize expects square")
+    e = coo.nnz
+    rows = jnp.concatenate([coo.rows, coo.cols])
+    cols = jnp.concatenate([coo.cols, coo.rows])
+    vals = jnp.concatenate([coo.vals, coo.vals]).astype(jnp.float32)
+    from_a = jnp.concatenate([jnp.ones((e,), bool), jnp.zeros((e,), bool)])
+    order = jnp.lexsort((cols, rows))
+    rs, cs, vs, fa = rows[order], cols[order], vals[order], from_a[order]
+    first = jnp.concatenate(
+        [
+            jnp.ones((1,), bool),
+            (rs[1:] != rs[:-1]) | (cs[1:] != cs[:-1]),
+        ]
+    )
+    group = jnp.cumsum(first.astype(jnp.int32)) - 1  # [2e] distinct-key id
+    m = 2 * e
+    fwd = jax.ops.segment_sum(jnp.where(fa, vs, 0.0), group, num_segments=m)
+    rev = jax.ops.segment_sum(jnp.where(fa, 0.0, vs), group, num_segments=m)
+    if op == "max":
+        combined = jnp.maximum(fwd, rev)
+    elif op == "mean":
+        combined = 0.5 * (fwd + rev)
+    else:
+        raise ValueError(f"unknown op {op}")
+    out_v = jnp.where(first, combined[group], 0.0)
+    return COO(rs, cs, out_v, coo.shape)
+
+
+def add(a: COO, b: COO) -> COO:
+    """Entrywise sum of two COO matrices (``sparse/linalg/add.cuh``);
+    static nnz = a.nnz + b.nnz (duplicates folded by to_dense/segment
+    consumers)."""
+    expects(a.shape == b.shape, "shape mismatch")
+    return COO(
+        jnp.concatenate([a.rows, b.rows]),
+        jnp.concatenate([a.cols, b.cols]),
+        jnp.concatenate([a.vals, b.vals]),
+        a.shape,
+    )

@@ -1,0 +1,81 @@
+"""Sparse-neighbors utilities — analog of
+``raft/sparse/neighbors/knn_graph.cuh`` (kNN graph of a dense dataset as a
+symmetric COO) and ``cross_component_nn.cuh`` (nearest neighbor between
+connected components, the single-linkage connectivity fix-up).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.errors import expects
+from raft_tpu.ops.distance import DistanceType, resolve_metric
+from raft_tpu.sparse.types import COO
+
+
+def knn_graph(X, k: int, metric=DistanceType.L2SqrtExpanded) -> COO:
+    """Symmetrized kNN graph as COO edges (``sparse/neighbors/
+    knn_graph.cuh``): each row connects to its k nearest (self excluded);
+    both edge directions are emitted (2*n*k static nnz)."""
+    from raft_tpu.neighbors import brute_force
+
+    metric = resolve_metric(metric)
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    expects(0 < k < n, "k out of range")
+    index = brute_force.build(X, metric=metric)
+    dists, nbrs = brute_force.search(index, X, k + 1)
+    # drop the self column (always rank 0 at distance 0 for L2-family)
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    self_mask = nbrs == jnp.arange(n, dtype=jnp.int32)[:, None]
+    order = jnp.argsort(self_mask, axis=1, stable=True)  # self column last
+    nbrs_k = jnp.take_along_axis(nbrs, order, axis=1)[:, :k].reshape(-1)
+    dists_k = jnp.take_along_axis(dists, order, axis=1)[:, :k].reshape(-1)
+    r = jnp.concatenate([rows, nbrs_k])
+    c = jnp.concatenate([nbrs_k, rows])
+    v = jnp.concatenate([dists_k, dists_k])
+    return COO(r, c, v.astype(jnp.float32), (n, n))
+
+
+def cross_component_nn(
+    X, labels, n_components: int, metric=DistanceType.L2SqrtExpanded
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Nearest neighboring point pair between each component and any other
+    component (``sparse/neighbors/cross_component_nn.cuh``): returns
+    (src_idx, dst_idx, dist) per component — the edges used to connect a
+    disconnected kNN graph before MST. Distances use ``metric`` so the
+    connector edges are commensurate with the kNN-graph weights."""
+    from raft_tpu.ops.distance import pairwise_distance
+
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(labels, jnp.int32)
+    n = X.shape[0]
+    metric = resolve_metric(metric)
+    # blocked scan: peak memory O(block * n), same bound as the rest of the
+    # sparse distance machinery
+    block = max(256, min(n, (1 << 24) // max(n, 1)))
+    bj_parts, bd_parts = [], []
+    for s in range(0, n, block):
+        d = pairwise_distance(X[s : s + block], X, metric)
+        same = y[s : s + block, None] == y[None, :]
+        d = jnp.where(same, jnp.inf, d)
+        bj = jnp.argmin(d, axis=1)
+        bj_parts.append(bj)
+        bd_parts.append(jnp.take_along_axis(d, bj[:, None], axis=1)[:, 0])
+    best_j = jnp.concatenate(bj_parts)
+    best_d = jnp.concatenate(bd_parts)
+    # per component: the row with the smallest foreign distance
+    comp_best = jax.ops.segment_min(best_d, y, num_segments=n_components)
+    is_best = best_d == comp_best[y]
+    # pick one representative row per component (lowest index)
+    row_ids = jnp.where(is_best, jnp.arange(n), n)
+    rep = jax.ops.segment_min(row_ids, y, num_segments=n_components)
+    rep_np = np.asarray(rep)
+    keep = rep_np < n
+    src = rep_np[keep]
+    dst = np.asarray(best_j)[src]
+    dist = np.asarray(best_d)[src]
+    return src.astype(np.int32), dst.astype(np.int32), dist.astype(np.float32)

@@ -1,0 +1,176 @@
+"""Sparse solvers: Borůvka MST and Lanczos eigensolver — analogs of
+``raft/sparse/solver/mst.cuh`` (GPU Borůvka, ``mst_solver.cuh``) and
+``raft/sparse/solver/lanczos.cuh`` / ``raft/linalg/lanczos.cuh``.
+
+TPU-first MST: classic Borůvka, fully vectorized over the static edge list
+— per round, a segment-min picks each component's cheapest outgoing edge,
+pointer-jumping collapses the union-find forest, and masks retire internal
+edges; O(log V) rounds. The reference perturbs weights to break ties
+(``mst_solver.cuh`` alteration); here ties break on the (weight, edge-id)
+composite, which is deterministic without perturbation.
+
+Lanczos: m-step iteration with full reorthogonalization (the reference's
+restarted variant is an optimization, not a semantic difference), then an
+``eigh`` of the tridiagonal; the matvec is any callable — CSR ``spmv``,
+dense matmul, or a matrix-free operator.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.sparse.types import COO
+from raft_tpu.random.rng import as_key
+
+
+@dataclasses.dataclass
+class MSTResult:
+    """``Graph_COO`` output of ``mst::mst`` (``sparse/mst/mst.cuh``)."""
+
+    src: np.ndarray  # [n_mst_edges]
+    dst: np.ndarray
+    weights: np.ndarray
+    n_edges: int
+
+
+def _pointer_jump(parent: jax.Array, rounds: int) -> jax.Array:
+    def body(_, p):
+        return p[p]
+
+    return lax.fori_loop(0, rounds, body, parent)
+
+
+def mst(coo: COO, max_rounds: Optional[int] = None) -> MSTResult:
+    """Minimum spanning forest of an undirected graph given as COO edges
+    (both directions or one — direction is ignored). Vectorized Borůvka;
+    returns the selected edges (host arrays, build-time API like the
+    reference's ``mst::mst``)."""
+    n = coo.shape[0]
+    expects(coo.shape[0] == coo.shape[1], "mst expects square adjacency")
+    e = coo.nnz
+    # typical Borůvka converges in O(log V) rounds; hook-contest losers can
+    # defer a merge, so the safety bound is V (each round performs >= 1
+    # union while any cross edge remains)
+    rounds = max_rounds or n
+    jump = max(1, int(np.ceil(np.log2(max(n, 2)))))
+
+    src = jnp.asarray(coo.rows, jnp.int32)
+    dst = jnp.asarray(coo.cols, jnp.int32)
+    w = jnp.asarray(coo.vals, jnp.float32)
+    valid0 = (src != dst) & (src >= 0) & (dst >= 0)
+
+    # deterministic tie-break: (weight, edge id) lexicographic via argsort
+    # rank — every edge gets a unique integer severity
+    order = jnp.argsort(w, stable=True)
+    rank = jnp.zeros((e,), jnp.int32).at[order].set(jnp.arange(e, dtype=jnp.int32))
+
+    parent0 = jnp.arange(n, dtype=jnp.int32)
+    chosen0 = jnp.zeros((e,), bool)
+
+    def round_body(state):
+        parent, chosen, changed, it = state
+        comp_s = parent[src]
+        comp_d = parent[dst]
+        cross = (comp_s != comp_d) & valid0
+        # cheapest outgoing edge per component (segment-min over rank)
+        big = jnp.int32(e)
+        r = jnp.where(cross, rank, big)
+        best_s = jax.ops.segment_min(r, comp_s, num_segments=n)  # [n]
+        best_d = jax.ops.segment_min(r, comp_d, num_segments=n)
+        best = jnp.minimum(best_s, best_d)  # per-component cheapest edge rank
+        # an edge is selected if it is the best of either endpoint component
+        sel = cross & ((best[comp_s] == rank) | (best[comp_d] == rank))
+        # union: hook the higher-root component onto the lower. Several
+        # selected edges may target the same `hi`; only the min-rank hook
+        # per `hi` wins (the GPU reference resolves this with atomicMin,
+        # mst_solver.cuh) — losers retry in a later round, so every chosen
+        # edge corresponds to exactly one performed union (no cycles).
+        lo = jnp.minimum(comp_s, comp_d)
+        hi = jnp.maximum(comp_s, comp_d)
+        r_hook = jnp.where(sel, rank, big)
+        win = jax.ops.segment_min(r_hook, hi, num_segments=n)
+        sel = sel & (win[hi] == rank)
+        parent = parent.at[jnp.where(sel, hi, n)].set(
+            jnp.where(sel, lo, 0), mode="drop"
+        )
+        parent = _pointer_jump(parent, jump)
+        return parent, chosen | sel, jnp.any(sel), it + 1
+
+    def cond(state):
+        _, _, changed, it = state
+        return changed & (it < rounds)
+
+    parent, chosen, _, _ = lax.while_loop(
+        cond, round_body, (parent0, chosen0, jnp.bool_(True), jnp.int32(0))
+    )
+
+    chosen_np = np.asarray(chosen)
+    return MSTResult(
+        src=np.asarray(src)[chosen_np],
+        dst=np.asarray(dst)[chosen_np],
+        weights=np.asarray(w)[chosen_np],
+        n_edges=int(chosen_np.sum()),
+    )
+
+
+def lanczos(
+    matvec: Callable[[jax.Array], jax.Array],
+    n: int,
+    n_components: int,
+    m: Optional[int] = None,
+    which: str = "smallest",
+    key=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric Lanczos (``sparse/solver/lanczos.cuh``
+    ``computeSmallestEigenvectors`` / ``computeLargestEigenvectors``).
+
+    Returns (eigenvalues [k], eigenvectors [n, k]). ``m`` is the Krylov
+    size (default 4k+32, clamped to n); full reorthogonalization each step.
+    """
+    expects(which in ("smallest", "largest"), "which must be smallest|largest")
+    k = n_components
+    m = min(n, m or max(2 * k + 16, 32))
+    expects(k <= m, "n_components must be <= Krylov size")
+
+    v0 = jax.random.normal(as_key(key if key is not None else 0), (n,), jnp.float32)
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    V = jnp.zeros((m, n), jnp.float32).at[0].set(v0)
+    alpha = jnp.zeros((m,), jnp.float32)
+    beta = jnp.zeros((m,), jnp.float32)
+
+    def step(i, state):
+        V, alpha, beta = state
+        v = V[i]
+        w = matvec(v)
+        a = jnp.dot(w, v)
+        w = w - a * v - jnp.where(i > 0, beta[i - 1], 0.0) * V[jnp.maximum(i - 1, 0)]
+        # full reorthogonalization (mask rows > i)
+        mask = (jnp.arange(m) <= i)[:, None]
+        proj = (V * mask) @ w  # [m]
+        w = w - (V * mask).T @ proj
+        b = jnp.linalg.norm(w)
+        V = V.at[i + 1].set(jnp.where(b > 1e-8, w / jnp.maximum(b, 1e-30), 0.0))
+        return V.astype(jnp.float32), alpha.at[i].set(a), beta.at[i].set(b)
+
+    V, alpha, beta = lax.fori_loop(0, m - 1, step, (V, alpha, beta))
+    # last alpha
+    vm = V[m - 1]
+    alpha = alpha.at[m - 1].set(jnp.dot(matvec(vm), vm))
+
+    T = jnp.diag(alpha) + jnp.diag(beta[: m - 1], 1) + jnp.diag(beta[: m - 1], -1)
+    evals, evecs = jnp.linalg.eigh(T)  # ascending
+    if which == "smallest":
+        sel = jnp.arange(k)
+    else:
+        sel = jnp.arange(m - k, m)[::-1]
+    lam = evals[sel]
+    vecs = (evecs[:, sel].T @ V).T  # [n, k]
+    vecs = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=0, keepdims=True), 1e-30)
+    return lam, vecs

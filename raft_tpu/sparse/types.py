@@ -1,0 +1,134 @@
+"""Sparse containers — analog of ``raft/core/{coo_matrix,csr_matrix}.hpp``
+and ``raft/sparse/detail/{coo,csr}.cuh``.
+
+Pytree dataclasses with static nnz (TPU/XLA needs static shapes; the
+reference's growable device buffers become rebuild-on-change, which matches
+how every in-tree consumer actually uses them: build once, read many).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.errors import expects
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class COO:
+    """Coordinate-format sparse matrix (``sparse/detail/coo.cuh``)."""
+
+    rows: jax.Array  # [nnz] i32
+    cols: jax.Array  # [nnz] i32
+    vals: jax.Array  # [nnz]
+    shape: Tuple[int, int]
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.vals), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, shape=aux[0])
+
+    @property
+    def nnz(self) -> int:
+        return self.vals.shape[0]
+
+    def to_dense(self) -> jax.Array:
+        """``sparse/convert/dense.cuh``. Out-of-range coordinates (the
+        structural-padding convention: row == n_rows) are dropped."""
+        out = jnp.zeros(self.shape, self.vals.dtype)
+        return out.at[self.rows, self.cols].add(self.vals, mode="drop")
+
+    def sorted_by_row(self) -> "COO":
+        """Row-major sort (``sparse/op/sort.cuh`` coo_sort); lexsort on
+        (row, col) avoids composite-key overflow for large shapes."""
+        order = jnp.lexsort((self.cols, self.rows))
+        return COO(self.rows[order], self.cols[order], self.vals[order], self.shape)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class CSR:
+    """Compressed-sparse-row matrix (``sparse/detail/csr.cuh``)."""
+
+    indptr: jax.Array  # [n_rows + 1] i32
+    indices: jax.Array  # [nnz] i32
+    vals: jax.Array  # [nnz]
+    shape: Tuple[int, int]
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.vals), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, shape=aux[0])
+
+    @property
+    def nnz(self) -> int:
+        return self.vals.shape[0]
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr to one row id per nnz (``sparse/convert/coo.cuh``
+        csr_to_coo): a searchsorted over the static nnz axis."""
+        return (
+            jnp.searchsorted(
+                self.indptr, jnp.arange(self.nnz, dtype=self.indptr.dtype), side="right"
+            ).astype(jnp.int32)
+            - 1
+        )
+
+    def to_coo(self) -> COO:
+        return COO(self.row_ids(), self.indices, self.vals, self.shape)
+
+    def to_dense(self) -> jax.Array:
+        return self.to_coo().to_dense()
+
+
+def coo_from_dense(x, nnz: int = None) -> COO:
+    """Densify on host at build time (``sparse/convert`` analog). ``nnz``
+    pads/truncates to a static size; padding entries sit at the
+    out-of-range coordinate (n_rows, n_cols) so structural consumers
+    (``to_dense``, ``degree``, ``coo_to_csr`` — all segment/scatter-drop
+    based) ignore them."""
+    x_np = np.asarray(x)
+    expects(x_np.ndim == 2, "expects a matrix")
+    r, c = np.nonzero(x_np)
+    v = x_np[r, c]
+    if nnz is not None:
+        if len(v) > nnz:
+            r, c, v = r[:nnz], c[:nnz], v[:nnz]
+        elif len(v) < nnz:
+            pad = nnz - len(v)
+            r = np.concatenate([r, np.full(pad, x_np.shape[0], r.dtype)])
+            c = np.concatenate([c, np.full(pad, x_np.shape[1], c.dtype)])
+            v = np.concatenate([v, np.zeros(pad, v.dtype)])
+    return COO(
+        jnp.asarray(r, jnp.int32), jnp.asarray(c, jnp.int32), jnp.asarray(v), x_np.shape
+    )
+
+
+def csr_from_dense(x) -> CSR:
+    """``sparse/convert/csr.cuh`` analog (host-side at build time)."""
+    x_np = np.asarray(x)
+    expects(x_np.ndim == 2, "expects a matrix")
+    r, c = np.nonzero(x_np)
+    v = x_np[r, c]
+    indptr = np.zeros(x_np.shape[0] + 1, np.int32)
+    np.add.at(indptr, r + 1, 1)
+    indptr = np.cumsum(indptr).astype(np.int32)
+    return CSR(jnp.asarray(indptr), jnp.asarray(c, jnp.int32), jnp.asarray(v), x_np.shape)
+
+
+def coo_to_csr(coo: COO) -> CSR:
+    """``sparse/convert/csr.cuh`` sorted_coo_to_csr."""
+    s = coo.sorted_by_row()
+    counts = jax.ops.segment_sum(
+        jnp.ones((s.nnz,), jnp.int32), s.rows, num_segments=coo.shape[0]
+    )
+    indptr = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)]).astype(jnp.int32)
+    return CSR(indptr, s.cols, s.vals, coo.shape)

@@ -1,0 +1,21 @@
+"""Spectral layer — analog of ``raft/spectral``.
+
+See ``SURVEY.md`` §2.4 (``spectral/partition.cuh:52``,
+``spectral/modularity_maximization.cuh``, ``eigen_solvers.cuh``,
+``cluster_solvers.cuh``).
+"""
+from raft_tpu.spectral.partition import (
+    analyze_partition,
+    fit_embedding,
+    modularity,
+    modularity_maximization,
+    partition,
+)
+
+__all__ = [
+    "analyze_partition",
+    "fit_embedding",
+    "modularity",
+    "modularity_maximization",
+    "partition",
+]

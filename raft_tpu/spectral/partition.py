@@ -1,0 +1,105 @@
+"""Spectral graph partitioning / modularity maximization — analog of
+``raft/spectral/partition.cuh:52`` and
+``raft/spectral/modularity_maximization.cuh``.
+
+Same structure as the reference: a Lanczos eigensolver
+(:func:`raft_tpu.sparse.solver.lanczos`) produces the embedding — smallest
+eigenvectors of the graph Laplacian for min-balanced-cut partitioning,
+largest of B = A - d·dᵀ/2m for modularity — and k-means clusters the
+embedded vertices (``cluster_solvers.cuh`` kmeans_solver).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans
+from raft_tpu.core.errors import expects
+from raft_tpu.sparse.linalg import degree, spmv
+from raft_tpu.sparse.solver import lanczos
+from raft_tpu.sparse.types import COO, coo_to_csr
+
+
+def _laplacian_matvec(adj_csr, deg):
+    def mv(v):
+        return deg * v - spmv(adj_csr, v)
+
+    return mv
+
+
+def fit_embedding(adj: COO, n_components: int, which: str = "smallest") -> jax.Array:
+    """Spectral embedding [n, k]: eigenvectors of the Laplacian
+    (``partition.cuh`` eigen step). For ``which="smallest"`` the trivial
+    near-zero constant mode is skipped; for ``which="largest"`` the top k
+    are returned as-is."""
+    n = adj.shape[0]
+    expects(adj.shape[0] == adj.shape[1], "adjacency must be square")
+    csr = coo_to_csr(adj)
+    deg = jnp.asarray(
+        jax.ops.segment_sum(adj.vals.astype(jnp.float32), adj.rows, num_segments=n)
+    )
+    mv = _laplacian_matvec(csr, deg)
+    if which == "smallest":
+        lam, vecs = lanczos(mv, n, n_components + 1, which=which)
+        return vecs[:, 1 : n_components + 1]
+    lam, vecs = lanczos(mv, n, n_components, which=which)
+    return vecs
+
+
+def partition(adj: COO, n_clusters: int, seed: int = 0) -> Tuple[np.ndarray, jax.Array]:
+    """Balanced min-cut spectral partition (``partition.cuh:52``):
+    Laplacian eigenvectors + k-means. Returns (labels, embedding)."""
+    emb = fit_embedding(adj, max(1, n_clusters - 1))
+    out = kmeans.fit(emb, kmeans.KMeansParams(n_clusters=n_clusters, seed=seed, max_iter=50))
+    return np.asarray(out.labels), emb
+
+
+def modularity_maximization(adj: COO, n_clusters: int, seed: int = 0) -> np.ndarray:
+    """Cluster by maximizing modularity (``modularity_maximization.cuh``):
+    largest eigenvectors of B = A - d·dᵀ/(2m), then k-means."""
+    n = adj.shape[0]
+    csr = coo_to_csr(adj)
+    d = jnp.asarray(
+        jax.ops.segment_sum(adj.vals.astype(jnp.float32), adj.rows, num_segments=n)
+    )
+    two_m = jnp.maximum(jnp.sum(d), 1e-30)
+
+    def mv(v):
+        return spmv(csr, v) - d * (jnp.dot(d, v) / two_m)
+
+    _, vecs = lanczos(mv, n, n_clusters, which="largest")
+    out = kmeans.fit(vecs, kmeans.KMeansParams(n_clusters=n_clusters, seed=seed, max_iter=50))
+    return np.asarray(out.labels)
+
+
+def analyze_partition(adj: COO, labels) -> Tuple[float, float]:
+    """(edge_cut, cost) of a partition (``partition.cuh`` analyzePartition)."""
+    y = jnp.asarray(labels, jnp.int32)
+    cross = y[adj.rows] != y[adj.cols]
+    edge_cut = float(jnp.sum(jnp.where(cross, adj.vals, 0.0))) / 2.0
+    # cost = sum over clusters of cut(c) / size(c) (ratio cut)
+    n_clusters = int(jnp.max(y)) + 1
+    sizes = jax.ops.segment_sum(jnp.ones_like(y, jnp.float32), y, num_segments=n_clusters)
+    cut_per = jax.ops.segment_sum(
+        jnp.where(cross, adj.vals.astype(jnp.float32), 0.0), y[adj.rows], num_segments=n_clusters
+    )
+    cost = float(jnp.sum(cut_per / jnp.maximum(sizes, 1.0)))
+    return edge_cut, cost
+
+
+def modularity(adj: COO, labels) -> float:
+    """Newman modularity Q of a labeling (``modularity_maximization.cuh``
+    analyzeModularity)."""
+    y = jnp.asarray(labels, jnp.int32)
+    n = adj.shape[0]
+    d = jax.ops.segment_sum(adj.vals.astype(jnp.float32), adj.rows, num_segments=n)
+    two_m = float(jnp.sum(d))
+    same = y[adj.rows] == y[adj.cols]
+    a_in = float(jnp.sum(jnp.where(same, adj.vals, 0.0)))
+    n_clusters = int(jnp.max(y)) + 1
+    d_per = jax.ops.segment_sum(d, y, num_segments=n_clusters)
+    expected = float(jnp.sum(d_per * d_per)) / two_m
+    return (a_in - expected) / two_m

@@ -1,0 +1,139 @@
+"""single-linkage, spectral, label, and LAP tests
+(reference pattern: ``cpp/test/cluster/linkage.cu``,
+``cpp/test/sparse/spectral_matrix.cu``, ``cpp/test/label/*``,
+``cpp/test/lap/lap.cu``)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import label as label_mod
+from raft_tpu import solver, sparse, spectral
+from raft_tpu.cluster.single_linkage import single_linkage
+
+
+def _blobs(rng, per=30, centers=((0, 0), (10, 10), (-10, 10)), scale=0.5):
+    pts, labels = [], []
+    for i, c in enumerate(centers):
+        pts.append(np.asarray(c) + scale * rng.standard_normal((per, 2)))
+        labels += [i] * per
+    return np.concatenate(pts).astype(np.float32), np.array(labels)
+
+
+class TestSingleLinkage:
+    def test_recovers_blobs(self, rng):
+        X, y = _blobs(rng)
+        out = single_linkage(X, n_clusters=3)
+        assert out.labels.shape == (90,)
+        assert len(np.unique(out.labels)) == 3
+        # clustering must match ground truth up to permutation (ARI == 1)
+        from raft_tpu.stats import adjusted_rand_index
+
+        assert float(adjusted_rand_index(y, out.labels)) > 0.99
+
+    def test_dendrogram_structure(self, rng):
+        X, _ = _blobs(rng, per=10)
+        n = X.shape[0]
+        out = single_linkage(X, n_clusters=2)
+        assert out.children.shape == (n - 1, 2)
+        assert (np.diff(out.deltas) >= -1e-6).all()  # merges in weight order
+        assert out.sizes[-1] == n  # final merge contains everything
+
+    def test_matches_scipy_linkage_heights(self, rng):
+        from scipy.cluster.hierarchy import linkage
+
+        X, _ = _blobs(rng, per=8)
+        out = single_linkage(X, n_clusters=1, c=7)
+        ref = linkage(X, method="single", metric="euclidean")
+        # f32 device distances vs scipy's f64: small rounding differences
+        np.testing.assert_allclose(np.sort(out.deltas), np.sort(ref[:, 2]), rtol=5e-3, atol=1e-3)
+
+
+class TestSpectral:
+    def _two_cliques(self):
+        # two 5-cliques joined by one weak edge
+        n = 10
+        dense = np.zeros((n, n), np.float32)
+        for block in (range(5), range(5, 10)):
+            for i in block:
+                for j in block:
+                    if i != j:
+                        dense[i, j] = 1.0
+        dense[4, 5] = dense[5, 4] = 0.1
+        return sparse.coo_from_dense(dense), n
+
+    def test_partition_two_cliques(self):
+        adj, n = self._two_cliques()
+        labels, emb = spectral.partition(adj, 2, seed=0)
+        assert emb.shape == (n, 1)
+        assert len(set(labels[:5])) == 1
+        assert len(set(labels[5:])) == 1
+        assert labels[0] != labels[9]
+
+    def test_analyze_partition_and_modularity(self):
+        adj, n = self._two_cliques()
+        good = np.array([0] * 5 + [1] * 5)
+        bad = np.array([0, 1] * 5)
+        cut_good, _ = spectral.analyze_partition(adj, good)
+        cut_bad, _ = spectral.analyze_partition(adj, bad)
+        assert cut_good < cut_bad
+        np.testing.assert_allclose(cut_good, 0.1, atol=1e-5)
+        assert spectral.modularity(adj, good) > spectral.modularity(adj, bad)
+
+    def test_modularity_maximization(self):
+        adj, n = self._two_cliques()
+        labels = spectral.modularity_maximization(adj, 2, seed=0)
+        assert len(set(labels[:5])) == 1
+        assert len(set(labels[5:])) == 1
+
+
+class TestLabel:
+    def test_make_monotonic(self):
+        y = np.array([10, 3, 10, 7, 3])
+        out, classes = label_mod.make_monotonic(y)
+        np.testing.assert_array_equal(np.asarray(classes), [3, 7, 10])
+        np.testing.assert_array_equal(np.asarray(out), [2, 0, 2, 1, 0])
+        out1, _ = label_mod.make_monotonic(y, zero_based=False)
+        np.testing.assert_array_equal(np.asarray(out1), [3, 1, 3, 2, 1])
+
+    def test_get_classes(self):
+        y = np.array([5, 1, 5, 2])
+        np.testing.assert_array_equal(np.asarray(label_mod.get_classes(y)), [1, 2, 5])
+
+    def test_merge_labels(self):
+        # a-groups: {0,1} {2,3} {4,5};  b-groups: {1,2} {3,4} -> all merge
+        a = np.array([0, 0, 1, 1, 2, 2])
+        b = np.array([0, 1, 1, 2, 2, 3])
+        out = np.asarray(label_mod.merge_labels(a, b))
+        assert len(set(out.tolist())) == 1
+        assert out.min() == 0
+
+    def test_merge_labels_masked(self):
+        # mask breaks the b-bridge between a-groups
+        a = np.array([0, 0, 1, 1])
+        b = np.array([0, 1, 1, 2])
+        mask = np.array([True, False, True, True])  # point 1 not a core point
+        out = np.asarray(label_mod.merge_labels(a, b, mask))
+        assert out[0] == out[1]  # a-group survives
+        assert out[2] == out[3]
+        assert out[0] != out[2]  # bridge severed by mask
+
+
+class TestLap:
+    def test_matches_scipy(self, rng):
+        from scipy.optimize import linear_sum_assignment
+
+        for n in (3, 8, 20):
+            c = rng.random((n, n)).astype(np.float64)
+            rows, cols, total = solver.lap_solve(c)
+            ri, ci = linear_sum_assignment(c)
+            np.testing.assert_allclose(total, c[ri, ci].sum(), rtol=1e-9)
+            # assignment is a permutation
+            assert sorted(rows.tolist()) == list(range(n))
+            np.testing.assert_array_equal(np.argsort(cols), rows)
+
+    def test_identity_case(self):
+        c = np.array([[1.0, 9, 9], [9, 1.0, 9], [9, 9, 1.0]])
+        rows, _, total = solver.lap_solve(c)
+        np.testing.assert_array_equal(rows, [0, 1, 2])
+        assert total == 3.0

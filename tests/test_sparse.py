@@ -1,0 +1,209 @@
+"""Sparse suite tests vs scipy (reference pattern: ``cpp/test/sparse/*``
+compares against host/cusparse references)."""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from raft_tpu import sparse
+from raft_tpu.ops.distance import DistanceType
+from raft_tpu.sparse import linalg as slinalg
+
+
+def _rand_sparse(rng, m, n, density=0.2):
+    mat = sp.random(m, n, density=density, random_state=np.random.RandomState(42), format="csr")
+    mat.data = rng.standard_normal(mat.nnz).astype(np.float32)
+    return mat
+
+
+class TestContainers:
+    def test_coo_csr_roundtrip(self, rng):
+        ref = _rand_sparse(rng, 10, 8)
+        dense = ref.toarray().astype(np.float32)
+        coo = sparse.coo_from_dense(dense)
+        np.testing.assert_allclose(np.asarray(coo.to_dense()), dense, rtol=1e-6)
+        csr = sparse.csr_from_dense(dense)
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), dense, rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(csr.indptr), ref.indptr)
+        np.testing.assert_array_equal(np.asarray(csr.indices), ref.indices)
+        # coo -> csr
+        csr2 = sparse.coo_to_csr(coo)
+        np.testing.assert_allclose(np.asarray(csr2.to_dense()), dense, rtol=1e-6)
+        # row_ids expansion
+        rows_ref = np.repeat(np.arange(10), np.diff(ref.indptr))
+        np.testing.assert_array_equal(np.asarray(csr.row_ids()), rows_ref)
+
+    def test_static_nnz_padding(self, rng):
+        dense = np.zeros((4, 4), np.float32)
+        dense[0, 1] = 2.0
+        coo = sparse.coo_from_dense(dense, nnz=5)
+        assert coo.nnz == 5
+        np.testing.assert_allclose(np.asarray(coo.to_dense()), dense)
+
+
+class TestSparseLinalg:
+    def test_spmv_spmm(self, rng):
+        ref = _rand_sparse(rng, 12, 9)
+        a = sparse.csr_from_dense(ref.toarray())
+        x = rng.standard_normal(9).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(slinalg.spmv(a, x)), ref @ x, rtol=1e-4, atol=1e-5)
+        b = rng.standard_normal((9, 6)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(slinalg.spmm(a, b)), ref @ b, rtol=1e-4, atol=1e-5)
+
+    def test_sddmm(self, rng):
+        a = rng.standard_normal((6, 4)).astype(np.float32)
+        b = rng.standard_normal((4, 7)).astype(np.float32)
+        mask_dense = (rng.random((6, 7)) < 0.3).astype(np.float32)
+        mask = sparse.coo_from_dense(mask_dense)
+        out = slinalg.sddmm(a, b, mask, alpha=2.0, beta=1.0)
+        full = 2.0 * (a @ b) + 1.0 * mask_dense
+        expected = np.where(mask_dense > 0, full, 0.0)
+        np.testing.assert_allclose(np.asarray(out.to_dense()), expected, rtol=1e-4, atol=1e-5)
+
+    def test_transpose_degree_norm(self, rng):
+        ref = _rand_sparse(rng, 8, 5)
+        a = sparse.csr_from_dense(ref.toarray())
+        at = slinalg.transpose(a)
+        np.testing.assert_allclose(np.asarray(at.to_dense()), ref.toarray().T, rtol=1e-6)
+        coo = a.to_coo()
+        np.testing.assert_array_equal(
+            np.asarray(slinalg.degree(coo)), np.diff(ref.indptr)
+        )
+        np.testing.assert_allclose(
+            np.asarray(slinalg.row_norm_csr(a, "l2")),
+            np.asarray((ref.multiply(ref)).sum(1)).ravel(),
+            rtol=1e-4,
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(slinalg.row_norm_csr(a, "l1")),
+            np.abs(ref).sum(1).A.ravel() if hasattr(np.abs(ref).sum(1), "A") else np.asarray(np.abs(ref).sum(1)).ravel(),
+            rtol=1e-4,
+            atol=1e-6,
+        )
+
+    def test_symmetrize_with_duplicates(self):
+        # duplicate (0,1) entries coalesce by sum before combining with Aᵀ
+        coo = sparse.COO(
+            jnp.asarray([0, 0, 1], jnp.int32),
+            jnp.asarray([1, 1, 0], jnp.int32),
+            jnp.asarray([1.0, 2.0, 4.0], jnp.float32),
+            (2, 2),
+        )
+        np.testing.assert_allclose(
+            np.asarray(slinalg.symmetrize(coo, "mean").to_dense()),
+            [[0, 3.5], [3.5, 0]],
+        )
+        np.testing.assert_allclose(
+            np.asarray(slinalg.symmetrize(coo, "max").to_dense()),
+            [[0, 4.0], [4.0, 0]],
+        )
+
+    def test_padded_coo_structural_ops(self):
+        dense = np.zeros((4, 4), np.float32)
+        dense[1, 2] = 2.0
+        dense[2, 0] = 3.0
+        coo = sparse.coo_from_dense(dense, nnz=8)
+        np.testing.assert_array_equal(np.asarray(slinalg.degree(coo)), [0, 1, 1, 0])
+        csr = sparse.coo_to_csr(coo)
+        np.testing.assert_array_equal(np.asarray(csr.indptr), [0, 0, 1, 2, 2])
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+
+    def test_symmetrize(self, rng):
+        dense = np.triu(rng.random((6, 6)).astype(np.float32) * (rng.random((6, 6)) < 0.4), 1)
+        coo = sparse.coo_from_dense(dense)
+        sym_max = slinalg.symmetrize(coo, "max").to_dense()
+        np.testing.assert_allclose(
+            np.asarray(sym_max), np.maximum(dense, dense.T), rtol=1e-6
+        )
+        sym_mean = slinalg.symmetrize(coo, "mean").to_dense()
+        np.testing.assert_allclose(np.asarray(sym_mean), 0.5 * (dense + dense.T), rtol=1e-6)
+
+
+class TestSparseDistance:
+    def test_pairwise_matches_dense(self, rng):
+        from raft_tpu.ops.distance import pairwise_distance
+
+        xd = (rng.random((20, 12)) * (rng.random((20, 12)) < 0.4)).astype(np.float32)
+        yd = (rng.random((15, 12)) * (rng.random((15, 12)) < 0.4)).astype(np.float32)
+        x = sparse.csr_from_dense(xd)
+        y = sparse.csr_from_dense(yd)
+        for metric in [DistanceType.L2Expanded, DistanceType.InnerProduct, DistanceType.L1]:
+            ours = np.asarray(sparse.pairwise_distance_sparse(x, y, metric))
+            ref = np.asarray(pairwise_distance(xd, yd, metric))
+            np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_knn_sparse(self, rng):
+        xd = (rng.random((30, 10)) * (rng.random((30, 10)) < 0.5)).astype(np.float32)
+        x = sparse.csr_from_dense(xd)
+        d, i = sparse.knn_sparse(x, x, 3, block=16)  # force multi-block path
+        d2 = ((xd[:, None, :] - xd[None, :, :]) ** 2).sum(-1)
+        ref_i = np.argsort(d2, axis=1)[:, :3]
+        np.testing.assert_allclose(
+            np.sort(np.asarray(d), axis=1)[:, 0], d2[np.arange(30), ref_i[:, 0]], atol=1e-4
+        )
+
+
+class TestSolvers:
+    def test_mst_matches_scipy(self, rng):
+        from scipy.sparse.csgraph import minimum_spanning_tree
+
+        n = 40
+        X = rng.standard_normal((n, 3)).astype(np.float32)
+        d = ((X[:, None] - X[None, :]) ** 2).sum(-1).astype(np.float32)
+        # complete graph edges (upper triangle)
+        iu, ju = np.triu_indices(n, 1)
+        coo = sparse.COO(
+            jnp.asarray(iu, jnp.int32),
+            jnp.asarray(ju, jnp.int32),
+            jnp.asarray(d[iu, ju]),
+            (n, n),
+        )
+        res = sparse.mst(coo)
+        assert res.n_edges == n - 1
+        ref = minimum_spanning_tree(sp.csr_matrix(np.triu(d, 1))).toarray()
+        np.testing.assert_allclose(res.weights.sum(), ref.sum(), rtol=1e-4)
+
+    def test_mst_forest_on_disconnected(self, rng):
+        # two components -> n-2 edges
+        e_src = np.array([0, 1, 3, 4], np.int32)
+        e_dst = np.array([1, 2, 4, 5], np.int32)
+        w = np.array([1.0, 2.0, 1.5, 2.5], np.float32)
+        coo = sparse.COO(jnp.asarray(e_src), jnp.asarray(e_dst), jnp.asarray(w), (6, 6))
+        res = sparse.mst(coo)
+        assert res.n_edges == 4  # already a forest
+        np.testing.assert_allclose(sorted(res.weights.tolist()), sorted(w.tolist()))
+
+    def test_lanczos_smallest_largest(self, rng):
+        n = 60
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        s = (a + a.T) / 2 + n * np.eye(n, dtype=np.float32)
+        ref = np.linalg.eigvalsh(s)
+        lam_s, vec_s = sparse.lanczos(lambda v: jnp.asarray(s) @ v, n, 3, which="smallest")
+        np.testing.assert_allclose(np.asarray(lam_s), ref[:3], rtol=1e-3)
+        lam_l, _ = sparse.lanczos(lambda v: jnp.asarray(s) @ v, n, 2, which="largest")
+        np.testing.assert_allclose(np.asarray(lam_l), ref[-1:-3:-1], rtol=1e-3)
+        # residual check
+        for j in range(3):
+            r = s @ np.asarray(vec_s)[:, j] - float(lam_s[j]) * np.asarray(vec_s)[:, j]
+            assert np.linalg.norm(r) < 1e-2 * max(1.0, abs(float(lam_s[j])))
+
+    def test_knn_graph_and_cross_component(self, rng):
+        X = np.concatenate(
+            [
+                rng.standard_normal((20, 2)).astype(np.float32),
+                rng.standard_normal((20, 2)).astype(np.float32) + 50.0,
+            ]
+        )
+        g = sparse.knn_graph(X, 3)
+        assert g.nnz == 2 * 40 * 3
+        dense = np.asarray(g.to_dense())
+        assert (dense >= 0).all()
+        # symmetric support
+        assert ((dense > 0) == (dense.T > 0)).all()
+        labels = np.array([0] * 20 + [1] * 20)
+        src, dst, dist = sparse.cross_component_nn(X, labels, 2)
+        assert len(src) == 2
+        assert labels[src[0]] != labels[dst[0]]
+        assert labels[src[1]] != labels[dst[1]]
