@@ -3,7 +3,26 @@ NN-descent, refine, filters.
 
 See ``SURVEY.md`` §2.4 (``/root/reference/cpp/include/raft/neighbors``).
 """
-from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, nn_descent
+from raft_tpu.neighbors import (
+    ball_cover,
+    brute_force,
+    cagra,
+    hnsw,
+    ivf_flat,
+    ivf_pq,
+    nn_descent,
+)
+from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
 from raft_tpu.neighbors.refine import refine
 
-__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "nn_descent", "refine"]
+__all__ = [
+    "ball_cover",
+    "brute_force",
+    "cagra",
+    "eps_neighbors",
+    "hnsw",
+    "ivf_flat",
+    "ivf_pq",
+    "nn_descent",
+    "refine",
+]

@@ -1,0 +1,36 @@
+"""Epsilon neighborhood — analog of
+``raft::neighbors::epsilon_neighborhood``
+(``neighbors/epsilon_neighborhood.cuh`` ``epsUnexpL2SqNeighborhood``).
+
+One tiled distance pass producing a boolean adjacency + vertex degrees;
+XLA fuses the compare into the distance epilogue.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+from raft_tpu.ops.distance import DistanceType, pairwise_distance, resolve_metric
+
+
+def eps_neighbors(
+    x, y, eps: float, metric=DistanceType.L2Expanded, block: int = 4096
+) -> Tuple[jax.Array, jax.Array]:
+    """Adjacency ``adj[i, j] = dist(x_i, y_j) < eps`` plus per-row degrees
+    (``epsUnexpL2SqNeighborhood``'s (adj, vd) outputs; the reference fixes
+    the metric to squared L2 — here any dense metric works, with ``eps``
+    in that metric's units)."""
+    metric = resolve_metric(metric)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1], "bad shapes")
+    adj_parts = []
+    for s in range(0, x.shape[0], block):
+        d = pairwise_distance(x[s : s + block], y, metric)
+        adj_parts.append(d < eps)
+    adj = jnp.concatenate(adj_parts, axis=0)
+    vd = jnp.sum(adj, axis=1, dtype=jnp.int32)
+    return adj, vd

@@ -1,0 +1,132 @@
+"""Ball cover, eps-neighborhood, and HNSW export tests
+(reference pattern: ``cpp/test/neighbors/ball_cover.cu``,
+``cpp/test/neighbors/epsilon_neighborhood.cu``,
+``cpp/test/neighbors/hnsw.cu``)."""
+import io
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import ball_cover, cagra, eps_neighbors, hnsw
+from raft_tpu.neighbors.cagra import CagraIndexParams, CagraSearchParams
+from raft_tpu.ops.distance import DistanceType
+from raft_tpu.stats import neighborhood_recall
+
+
+def _geo(rng, n):
+    lat = rng.uniform(-np.pi / 2, np.pi / 2, n)
+    lon = rng.uniform(-np.pi, np.pi, n)
+    return np.stack([lat, lon], 1).astype(np.float32)
+
+
+def _haversine(a, b):
+    s0 = np.sin(0.5 * (a[:, None, 0] - b[None, :, 0]))
+    s1 = np.sin(0.5 * (a[:, None, 1] - b[None, :, 1]))
+    r = s0 * s0 + np.cos(a[:, None, 0]) * np.cos(b[None, :, 0]) * s1 * s1
+    return 2 * np.arcsin(np.sqrt(np.clip(r, 0, 1)))
+
+
+class TestEpsNeighbors:
+    def test_matches_numpy(self, rng):
+        x = rng.standard_normal((50, 4)).astype(np.float32)
+        y = rng.standard_normal((80, 4)).astype(np.float32)
+        eps = 4.0
+        adj, vd = eps_neighbors(x, y, eps)
+        d2 = ((x[:, None] - y[None, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(np.asarray(adj), d2 < eps)
+        np.testing.assert_array_equal(np.asarray(vd), (d2 < eps).sum(1))
+
+    def test_blocked_path(self, rng):
+        x = rng.standard_normal((40, 3)).astype(np.float32)
+        adj1, _ = eps_neighbors(x, x, 2.0, block=7)
+        adj2, _ = eps_neighbors(x, x, 2.0)
+        np.testing.assert_array_equal(np.asarray(adj1), np.asarray(adj2))
+
+
+class TestBallCover:
+    def test_knn_haversine_exact(self, rng):
+        X = _geo(rng, 600)
+        Q = _geo(rng, 40)
+        index = ball_cover.build(X, metric=DistanceType.Haversine)
+        assert index.n_landmarks == int(np.sqrt(600))
+        d, i = ball_cover.knn_query(index, Q, 5, block=256)
+        ref = _haversine(Q, X)
+        ref_i = np.argsort(ref, axis=1)[:, :5]
+        recall = float(neighborhood_recall(np.asarray(i), ref_i))
+        assert recall >= 0.999, f"rbc recall {recall}"
+        np.testing.assert_allclose(
+            np.asarray(d)[:, 0], np.sort(ref, axis=1)[:, 0], atol=1e-5
+        )
+
+    def test_knn_euclidean(self, rng):
+        X = rng.standard_normal((400, 3)).astype(np.float32)
+        Q = rng.standard_normal((20, 3)).astype(np.float32)
+        index = ball_cover.build(X, metric=DistanceType.L2SqrtExpanded)
+        _, i = ball_cover.knn_query(index, Q, 4)
+        d2 = ((Q[:, None] - X[None, :]) ** 2).sum(-1)
+        ref_i = np.argsort(d2, axis=1)[:, :4]
+        assert float(neighborhood_recall(np.asarray(i), ref_i)) >= 0.999
+
+    def test_eps_query_exact_despite_pruning(self, rng):
+        X = _geo(rng, 500)
+        Q = _geo(rng, 30)
+        index = ball_cover.build(X, metric=DistanceType.Haversine)
+        eps = 0.5
+        adj, vd = ball_cover.eps_query(index, Q, eps)
+        ref = _haversine(Q, X) < eps
+        np.testing.assert_array_equal(np.asarray(adj), ref)
+        np.testing.assert_array_equal(np.asarray(vd), ref.sum(1))
+
+
+class TestHnsw:
+    def _index(self, rng, n=1200, d=16):
+        centers = rng.standard_normal((8, d)).astype(np.float32)
+        X = (centers[rng.integers(0, 8, n)] + 0.3 * rng.standard_normal((n, d))).astype(
+            np.float32
+        )
+        return X, cagra.build(
+            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=0)
+        )
+
+    def test_serialize_format_roundtrip(self, rng):
+        X, index = self._index(rng)
+        buf = io.BytesIO()
+        hnsw.serialize_to_hnswlib(index, buf)
+        # file size must match the exact hnswlib layout
+        n, dim, deg = X.shape[0], X.shape[1], index.graph_degree
+        expected = 8 * 6 + 8 + 24 + 16 + n * (4 + deg * 4 + dim * 4 + 8) + n * 4
+        assert buf.tell() == expected
+        buf.seek(0)
+        loaded = hnsw.load_hnswlib(buf)
+        np.testing.assert_allclose(loaded.dataset, X)
+        g = np.asarray(index.graph)
+        rows = np.arange(n)[:, None].repeat(deg, 1)
+        np.testing.assert_array_equal(loaded.graph, np.where(g < 0, rows, g))
+        assert loaded.entrypoint == n // 2
+
+    def test_search_through_export(self, rng):
+        X, index = self._index(rng)
+        Q = X[:32] + 0.01
+        h = hnsw.from_cagra(index)
+        d, i = hnsw.search(h, Q, 5, ef=64)
+        from raft_tpu.neighbors import brute_force
+
+        _, ref = brute_force.search(brute_force.build(X), Q, 5)
+        recall = float(neighborhood_recall(i, np.asarray(ref)))
+        assert recall >= 0.9, f"hnsw-export recall {recall}"
+
+    @pytest.mark.skipif(
+        not pytest.importorskip("importlib").util.find_spec("hnswlib"),
+        reason="hnswlib not installed",
+    )
+    def test_real_hnswlib_loads_file(self, rng, tmp_path):
+        import hnswlib
+
+        X, index = self._index(rng)
+        path = tmp_path / "cagra.hnsw"
+        with open(path, "wb") as f:
+            hnsw.serialize_to_hnswlib(index, f)
+        p = hnswlib.Index(space="l2", dim=X.shape[1])
+        p.load_index(str(path), max_elements=X.shape[0])
+        labels, _ = p.knn_query(X[:8], k=3)
+        assert labels.shape == (8, 3)
