@@ -37,10 +37,13 @@ def merge_labels(labels_a, labels_b, mask=None, n_iters: int = 0) -> jax.Array:
     (``merge_labels.cuh``): points sharing a label in EITHER input end in
     the same output group; each group takes its minimum ``labels_a`` value.
 
-    Implemented as iterated min-propagation through both label spaces (the
-    reference kernel does the same fixed-point with atomicMin); ``mask``
-    restricts which points participate in ``labels_b`` groups (the
-    reference's core-point mask).
+    Implemented as min-propagation through both label spaces iterated to a
+    fixed point (the reference kernel does the same with atomicMin and a
+    host change-flag do/while, ``detail/merge_labels.cuh``); chains of
+    alternating equivalences need up to O(n) passes, so a fixed iteration
+    count is not enough. ``mask`` restricts which points participate in
+    ``labels_b`` groups (the reference's core-point mask). ``n_iters > 0``
+    caps the pass count instead of running to convergence.
     """
     a = jnp.asarray(labels_a, jnp.int32)
     b = jnp.asarray(labels_b, jnp.int32)
@@ -49,12 +52,10 @@ def merge_labels(labels_a, labels_b, mask=None, n_iters: int = 0) -> jax.Array:
     m = jnp.ones((n,), bool) if mask is None else jnp.asarray(mask, bool)
     na = int(jnp.max(a)) + 1
     nb = int(jnp.max(b)) + 1
-    iters = n_iters or max(2, int(jnp.ceil(jnp.log2(jnp.float32(max(n, 2))))) + 1)
 
     big = jnp.int32(jnp.iinfo(jnp.int32).max)
-    out = a
 
-    def body(_, out):
+    def one_pass(out):
         # group minimum over a-groups (all points)
         min_a = jax.ops.segment_min(out, a, num_segments=na)
         out = min_a[a]
@@ -64,4 +65,12 @@ def merge_labels(labels_a, labels_b, mask=None, n_iters: int = 0) -> jax.Array:
         prop = jnp.minimum(out, min_b[b])
         return jnp.where(m, prop, out)
 
-    return jax.lax.fori_loop(0, iters, body, out)
+    if n_iters:
+        return jax.lax.fori_loop(0, n_iters, lambda _, o: one_pass(o), a)
+
+    out, _ = jax.lax.while_loop(
+        lambda s: jnp.any(s[0] != s[1]),
+        lambda s: (one_pass(s[0]), s[0]),
+        (one_pass(a), a),
+    )
+    return out

@@ -128,11 +128,25 @@ def eps_query(
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact eps-ball adjacency (``rbc_eps_nn_query``,
     ``ball_cover-inl.cuh:314``) with the RBC landmark prune: whole
-    landmark groups whose lower bound ``dist(q, lm) - radius`` exceeds
-    ``eps`` are masked out before the point-level test."""
+    landmark groups whose triangle-inequality lower bound exceeds ``eps``
+    are masked out before the point-level test.
+
+    The reference restricts eps queries to metrics satisfying the triangle
+    inequality (``ball_cover-inl.cuh:323`` asserts L2Sqrt*); squared L2
+    does not satisfy it, so for ``L2Expanded`` indexes the bound is
+    computed in sqrt space: prune when
+    ``(sqrt(d_lm) - sqrt(radius))^2 > eps``."""
     queries = jnp.asarray(queries, jnp.float32)
     d_lm = pairwise_distance(queries, index.landmarks, index.metric)  # [nq, L]
-    group_ok = (d_lm - index.radii[None, :]) <= eps  # [nq, L]
+    if index.metric == DistanceType.L2Expanded:
+        lb = jnp.maximum(
+            jnp.sqrt(jnp.maximum(d_lm, 0.0))
+            - jnp.sqrt(jnp.maximum(index.radii, 0.0))[None, :],
+            0.0,
+        )
+        group_ok = (lb * lb) <= eps  # [nq, L]
+    else:
+        group_ok = (d_lm - index.radii[None, :]) <= eps  # [nq, L]
     d = pairwise_distance(queries, index.dataset, index.metric)  # [nq, n]
     adj = (d < eps) & group_ok[:, index.assignments]
     vd = jnp.sum(adj, axis=1, dtype=jnp.int32)

@@ -127,11 +127,16 @@ def _detour_rerank_chunk(graph, chunk_ids, *, kout: int):
     """
     kin = graph.shape[1]
     rows = graph[chunk_ids]  # [c, kin]
-    two_hop = graph[rows]  # [c, kin, kin]
+    # rows may hold -1 padding (e.g. the IVF-PQ build path's short kNN
+    # rows); a raw gather would wrap to the last node's adjacency and
+    # pollute detour counts, so gather clipped and mask the contribution.
+    rows_valid = rows >= 0  # [c, kin]
+    two_hop = graph[jnp.maximum(rows, 0)]  # [c, kin, kin]
 
     def body(a, counts):
         # hit[c, b] = G[A, b] ∈ two_hop[A, a, :]
         hit = jnp.any(two_hop[:, a, :, None] == rows[:, None, :], axis=1)
+        hit = hit & rows_valid[:, a][:, None]  # invalid rank-a edge: no 2-hop
         rank_mask = jnp.arange(kin) > a  # only edges ranked after a
         return counts + (hit & rank_mask[None, :]).astype(jnp.int32)
 

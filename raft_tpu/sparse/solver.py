@@ -131,14 +131,22 @@ def lanczos(
     ``computeSmallestEigenvectors`` / ``computeLargestEigenvectors``).
 
     Returns (eigenvalues [k], eigenvectors [n, k]). ``m`` is the Krylov
-    size (default 4k+32, clamped to n); full reorthogonalization each step.
+    size (default ``max(2k+16, 32)``, clamped to n); full
+    reorthogonalization each step. On breakdown (an invariant subspace is
+    found before ``m`` steps, ``beta ~ 0``) the iteration restarts with a
+    fresh random vector orthogonal to the converged block with ``beta``
+    set to exactly 0, so ``T`` becomes block-diagonal and every Ritz pair
+    stays genuine — no spurious zero eigenvalues (the reference's
+    ``lanczos.cuh`` restarts similarly).
     """
     expects(which in ("smallest", "largest"), "which must be smallest|largest")
     k = n_components
     m = min(n, m or max(2 * k + 16, 32))
     expects(k <= m, "n_components must be <= Krylov size")
 
-    v0 = jax.random.normal(as_key(key if key is not None else 0), (n,), jnp.float32)
+    base_key = as_key(key if key is not None else 0)
+    restart_key = jax.random.fold_in(base_key, 1)
+    v0 = jax.random.normal(base_key, (n,), jnp.float32)
     v0 = v0 / jnp.linalg.norm(v0)
 
     V = jnp.zeros((m, n), jnp.float32).at[0].set(v0)
@@ -146,7 +154,7 @@ def lanczos(
     beta = jnp.zeros((m,), jnp.float32)
 
     def step(i, state):
-        V, alpha, beta = state
+        V, alpha, beta, anorm = state
         v = V[i]
         w = matvec(v)
         a = jnp.dot(w, v)
@@ -156,10 +164,32 @@ def lanczos(
         proj = (V * mask) @ w  # [m]
         w = w - (V * mask).T @ proj
         b = jnp.linalg.norm(w)
-        V = V.at[i + 1].set(jnp.where(b > 1e-8, w / jnp.maximum(b, 1e-30), 0.0))
-        return V.astype(jnp.float32), alpha.at[i].set(a), beta.at[i].set(b)
+        # Breakdown test is relative to a running estimate of ||A|| so
+        # uniformly tiny matrices aren't misread as perpetual breakdown.
+        anorm = jnp.maximum(anorm, jnp.abs(a) + b)
+        broke = b <= 1e-6 * anorm
 
-    V, alpha, beta = lax.fori_loop(0, m - 1, step, (V, alpha, beta))
+        # Breakdown: restart with a random vector orthogonal to the
+        # converged block; beta[i] = 0 keeps T exactly block-diagonal.
+        def restart(_):
+            r = jax.random.normal(
+                jax.random.fold_in(restart_key, i), (n,), jnp.float32
+            )
+            r = r - (V * mask).T @ ((V * mask) @ r)
+            return r / jnp.maximum(jnp.linalg.norm(r), 1e-30)
+
+        vnext = lax.cond(broke, restart, lambda _: w / jnp.maximum(b, 1e-30), None)
+        V = V.at[i + 1].set(vnext)
+        return (
+            V.astype(jnp.float32),
+            alpha.at[i].set(a),
+            beta.at[i].set(jnp.where(broke, 0.0, b)),
+            anorm,
+        )
+
+    V, alpha, beta, _ = lax.fori_loop(
+        0, m - 1, step, (V, alpha, beta, jnp.float32(1e-30))
+    )
     # last alpha
     vm = V[m - 1]
     alpha = alpha.at[m - 1].set(jnp.dot(matvec(vm), vm))

@@ -47,6 +47,25 @@ class TestOptimize:
         fwd = np.asarray(cagra._detour_rerank_chunk(g, np.arange(4, dtype=np.int32), kout=1))
         assert fwd[0, 0] == 1  # rank-0 edge kept, detour edge 0->2 dropped
 
+    def test_detour_ignores_invalid_padding_edges(self):
+        # Regression (round-2 advisor): a -1 pad in a row used to wrap to
+        # the LAST node's adjacency, so its edges accrued phantom detour
+        # counts and valid edges got demoted. Node 0's row is [-1, 1, 2, 3]
+        # and node 4 (the wrap target) lists 1 — under the bug edge 0->1
+        # picked up a phantom detour and sorted after 2 and 3.
+        g = np.array(
+            [
+                [-1, 1, 2, 3],
+                [-1, -1, -1, -1],
+                [-1, -1, -1, -1],
+                [-1, -1, -1, -1],
+                [1, -1, -1, -1],
+            ],
+            np.int32,
+        )
+        fwd = np.asarray(cagra._detour_rerank_chunk(g, np.array([0], np.int32), kout=2))
+        np.testing.assert_array_equal(fwd[0], [1, 2])
+
     def test_reverse_merge_keeps_protected_head(self, rng):
         n, kout = 200, 8
         # rows must be duplicate-free (true of any real kNN graph)

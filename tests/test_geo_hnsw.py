@@ -77,6 +77,19 @@ class TestBallCover:
         np.testing.assert_array_equal(np.asarray(adj), ref)
         np.testing.assert_array_equal(np.asarray(vd), ref.sum(1))
 
+    def test_eps_query_squared_l2_exact(self, rng):
+        # Regression: squared L2 violates the triangle inequality, so the
+        # landmark prune must use the sqrt-space bound for L2Expanded
+        # (round-2 advisor finding: 181/4459 neighbors were dropped).
+        X = rng.standard_normal((400, 2)).astype(np.float32)
+        Q = rng.standard_normal((30, 2)).astype(np.float32)
+        index = ball_cover.build(X, metric=DistanceType.L2Expanded)
+        eps = 1.0
+        adj, vd = ball_cover.eps_query(index, Q, eps)
+        ref = ((Q[:, None] - X[None, :]) ** 2).sum(-1) < eps
+        np.testing.assert_array_equal(np.asarray(adj), ref)
+        np.testing.assert_array_equal(np.asarray(vd), ref.sum(1))
+
 
 class TestHnsw:
     def _index(self, rng, n=1200, d=16):

@@ -189,6 +189,20 @@ class TestSolvers:
             r = s @ np.asarray(vec_s)[:, j] - float(lam_s[j]) * np.asarray(vec_s)[:, j]
             assert np.linalg.norm(r) < 1e-2 * max(1.0, abs(float(lam_s[j])))
 
+    def test_lanczos_breakdown_restart(self, rng):
+        # Regression: a matrix with two eigenvalues {1, 3} makes the Krylov
+        # space invariant after ~2 steps; without restart the zeroed rows
+        # yield spurious 0 eigenvalues displacing the true smallest (=1).
+        n = 50
+        p = 5  # eigenvalue 3 on the first p coords, 1 elsewhere
+        diag = np.ones(n, np.float32)
+        diag[:p] = 3.0
+        mv = lambda v: jnp.asarray(diag) * v
+        lam_s, _ = sparse.lanczos(mv, n, 3, which="smallest")
+        np.testing.assert_allclose(np.asarray(lam_s), np.ones(3), rtol=1e-4)
+        lam_l, _ = sparse.lanczos(mv, n, 2, which="largest")
+        np.testing.assert_allclose(np.asarray(lam_l), np.full(2, 3.0), rtol=1e-4)
+
     def test_knn_graph_and_cross_component(self, rng):
         X = np.concatenate(
             [
