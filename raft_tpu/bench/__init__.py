@@ -1,0 +1,39 @@
+"""ANN benchmark harness (L8) — Python re-implementation of
+``cpp/bench/ann`` + ``python/raft-ann-bench`` (SURVEY.md §2.8).
+
+* :mod:`raft_tpu.bench.datasets` — dataset registry + ground-truth cache
+* :mod:`raft_tpu.bench.harness` — build/search timing, in-harness recall,
+  gbench-schema results, sweeps, Pareto / operating-point analysis
+* :mod:`raft_tpu.bench.configs` — per-algo parameter grids + constraints
+* ``python -m raft_tpu.bench`` — CLI orchestration
+"""
+from raft_tpu.bench.datasets import Dataset, get_dataset, make_clustered, make_uniform, read_fbin, write_fbin
+from raft_tpu.bench.harness import (
+    ALGOS,
+    BenchResult,
+    operating_point,
+    pareto_frontier,
+    recall_at_k,
+    run_case,
+    save_report,
+    sweep,
+    to_report,
+)
+
+__all__ = [
+    "ALGOS",
+    "BenchResult",
+    "Dataset",
+    "get_dataset",
+    "make_clustered",
+    "make_uniform",
+    "operating_point",
+    "pareto_frontier",
+    "read_fbin",
+    "recall_at_k",
+    "run_case",
+    "save_report",
+    "sweep",
+    "to_report",
+    "write_fbin",
+]

@@ -1,0 +1,66 @@
+"""CLI entry — the ``raft-ann-bench`` run orchestration
+(``python/raft-ann-bench/src/raft_ann_bench/run/__main__.py:141`` analog).
+
+Examples::
+
+    python -m raft_tpu.bench --dataset smoke-10k --algos raft_ivf_flat --group smoke
+    python -m raft_tpu.bench --dataset sift-128-euclidean --algos raft_ivf_flat,raft_cagra \
+        --k 10 --batch 1024 --out results.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+from raft_tpu.bench import configs, datasets, harness
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("raft_tpu.bench")
+    ap.add_argument("--dataset", default="smoke-10k")
+    ap.add_argument("--algos", default="raft_brute_force,raft_ivf_flat,raft_ivf_pq,raft_cagra")
+    ap.add_argument("--group", default="base", choices=sorted(configs.GROUPS))
+    ap.add_argument("-k", "--k", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--min-recall", type=float, default=0.95)
+    ap.add_argument("--min-search-time", type=float, default=2.0)
+    ap.add_argument("--out", default=None, help="write gbench-style JSON report here")
+    args = ap.parse_args(argv)
+
+    ds = datasets.get_dataset(args.dataset)
+    print(f"# dataset {ds.name}: n={ds.n} dim={ds.dim} nq={ds.queries.shape[0]} metric={ds.metric}")
+
+    all_results = []
+    for algo in args.algos.split(","):
+        algo = algo.strip()
+        grids = configs.GROUPS[args.group][algo]
+        res = harness.sweep(
+            ds,
+            algo,
+            grids["build"],
+            grids["search"],
+            k=args.k,
+            batch=args.batch,
+            min_search_time=args.min_search_time,
+            constraint=configs.constraint(algo),
+        )
+        all_results.extend(res)
+        op = harness.operating_point(res, args.min_recall)
+        if op:
+            print(
+                f"## {algo} @ recall>={args.min_recall}: {op.qps:,.0f} qps "
+                f"(recall={op.recall:.4f}, {harness._fmt(op.search_params)})"
+            )
+        else:
+            print(f"## {algo}: no config reached recall {args.min_recall}")
+
+    if args.out:
+        harness.save_report(all_results, args.out)
+        print(f"# wrote {args.out}")
+    else:
+        print(json.dumps([r.to_json() for r in harness.pareto_frontier(all_results)], indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,183 @@
+"""Benchmark dataset handling — analog of ``python/raft-ann-bench``'s
+``get_dataset`` + ``generate_groundtruth`` stages
+(``python/raft-ann-bench/src/raft_ann_bench/get_dataset/__main__.py``,
+``generate_groundtruth/__main__.py``) and the harness-side dataset object
+(``cpp/bench/ann/src/common/dataset.hpp``).
+
+The reference downloads ann-benchmarks HDF5 files and converts them to
+``.fbin``; this environment has zero egress, so the registry provides
+
+* **synthetic generators** shaped like the standard datasets (SIFT-1M-like
+  clustered float32, DEEP-like, plus uniform worst-case), deterministic by
+  seed, and
+* **``.fbin`` / ``.npy`` loaders** for datasets already on disk (bit-format
+  per ``cpp/bench/ann/src/common/dataset.hpp:37-94``: int32 [n_rows, dim]
+  header then row-major data).
+
+Ground truth is computed in-harness with the exact brute-force index (the
+reference generates it with pylibraft brute force) and cached on disk next
+to the dataset.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+DATA_DIR = os.environ.get("RAFT_TPU_BENCH_DATA", os.path.join(os.path.dirname(__file__), "..", "..", ".bench_cache"))
+
+
+@dataclasses.dataclass
+class Dataset:
+    """Base + query vectors with lazily computed/cached ground truth."""
+
+    name: str
+    base: np.ndarray  # [n, d]
+    queries: np.ndarray  # [nq, d]
+    metric: str = "euclidean"  # "euclidean" | "inner_product"
+    _gt: Optional[np.ndarray] = None
+
+    @property
+    def n(self) -> int:
+        return self.base.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.base.shape[1]
+
+    def ground_truth(self, k: int, batch: int = 512) -> np.ndarray:
+        """Exact top-k ids [nq, k] via brute force; disk-cached."""
+        if self._gt is not None and self._gt.shape[1] >= k:
+            return self._gt[:, :k]
+        cache = _gt_cache_path(self)
+        if cache and os.path.exists(cache):
+            gt = np.load(cache)
+            if gt.shape[0] == self.queries.shape[0] and gt.shape[1] >= k:
+                self._gt = gt
+                return gt[:, :k]
+        gt = _exact_knn(self.base, self.queries, max(k, 100), self.metric, batch)
+        if cache:
+            os.makedirs(os.path.dirname(cache), exist_ok=True)
+            np.save(cache, gt)
+        self._gt = gt
+        return gt[:, :k]
+
+
+def _fingerprint(ds: Dataset) -> str:
+    h = hashlib.sha1()
+    h.update(f"{ds.name}:{ds.base.shape}:{ds.queries.shape}:{ds.metric}".encode())
+    # sample a few rows so regenerated-with-different-seed data doesn't hit
+    h.update(np.ascontiguousarray(ds.base[:: max(1, ds.n // 64)][:64]).tobytes())
+    h.update(np.ascontiguousarray(ds.queries[:16]).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _gt_cache_path(ds: Dataset) -> Optional[str]:
+    try:
+        return os.path.join(os.path.abspath(DATA_DIR), "gt", f"{ds.name}-{_fingerprint(ds)}.npy")
+    except Exception:
+        return None
+
+
+def _exact_knn(base: np.ndarray, queries: np.ndarray, k: int, metric: str, batch: int) -> np.ndarray:
+    """Ground truth via the library's own exact index (reference uses
+    pylibraft brute force, ``generate_groundtruth/__main__.py:58``)."""
+    import jax
+
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.ops.distance import DistanceType
+
+    m = DistanceType.InnerProduct if metric == "inner_product" else DistanceType.L2Expanded
+    index = brute_force.build(base, metric=m)
+    outs = []
+    for s in range(0, queries.shape[0], batch):
+        _, i = brute_force.search(index, queries[s : s + batch], k)
+        outs.append(np.asarray(i))
+    jax.block_until_ready(outs[-1])
+    return np.concatenate(outs, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# synthetic generators (registry)
+# ---------------------------------------------------------------------------
+
+
+def make_clustered(
+    name: str,
+    n: int,
+    dim: int,
+    n_queries: int,
+    n_centers: Optional[int] = None,
+    cluster_std: float = 0.5,
+    metric: str = "euclidean",
+    seed: int = 1234,
+) -> Dataset:
+    """Clustered float32 data — the realistic ANN regime (real embedding
+    datasets are strongly clustered; uniform gaussians make every IVF/graph
+    method look artificially bad)."""
+    rng = np.random.default_rng(seed)
+    nc = n_centers or max(64, int(np.sqrt(n)))
+    centers = rng.standard_normal((nc, dim)).astype(np.float32)
+    base = centers[rng.integers(0, nc, n)] + cluster_std * rng.standard_normal((n, dim)).astype(np.float32)
+    queries = centers[rng.integers(0, nc, n_queries)] + cluster_std * rng.standard_normal(
+        (n_queries, dim)
+    ).astype(np.float32)
+    return Dataset(name, base.astype(np.float32), queries.astype(np.float32), metric)
+
+
+def make_uniform(name: str, n: int, dim: int, n_queries: int, metric: str = "euclidean", seed: int = 1234) -> Dataset:
+    rng = np.random.default_rng(seed)
+    return Dataset(
+        name,
+        rng.standard_normal((n, dim)).astype(np.float32),
+        rng.standard_normal((n_queries, dim)).astype(np.float32),
+        metric,
+    )
+
+
+def read_fbin(path: str, dtype=np.float32) -> np.ndarray:
+    """``.fbin``/``.ibin`` reader (``cpp/bench/ann/src/common/dataset.hpp:37``:
+    two int32 [n_rows, dim] then row-major data)."""
+    with open(path, "rb") as f:
+        n, d = np.fromfile(f, np.int32, 2)
+        return np.fromfile(f, dtype, int(n) * int(d)).reshape(int(n), int(d))
+
+
+def write_fbin(path: str, arr: np.ndarray) -> None:
+    with open(path, "wb") as f:
+        np.asarray(arr.shape, np.int32).tofile(f)
+        np.ascontiguousarray(arr).tofile(f)
+
+
+def load_fbin_dataset(name: str, base_path: str, query_path: str, metric: str = "euclidean", dtype=np.float32) -> Dataset:
+    return Dataset(name, read_fbin(base_path, dtype), read_fbin(query_path, dtype), metric)
+
+
+# Named registry mirroring run/conf/datasets.yaml shapes (synthetic stand-ins).
+_REGISTRY = {
+    # name: (n, dim, n_queries, metric)
+    "sift-128-euclidean": (1_000_000, 128, 1_000, "euclidean"),
+    "sift-128-euclidean-100k": (100_000, 128, 1_000, "euclidean"),
+    "deep-image-96-angular-1M": (1_000_000, 96, 1_000, "inner_product"),
+    "glove-100-angular-1M": (1_100_000, 100, 1_000, "inner_product"),
+    "nytimes-256-angular": (290_000, 256, 1_000, "inner_product"),
+    "smoke-10k": (10_000, 64, 200, "euclidean"),
+}
+
+
+def get_dataset(name: str, seed: int = 1234) -> Dataset:
+    """Fetch a registered synthetic dataset, or load ``name`` as an on-disk
+    pair ``<DATA_DIR>/<name>/base.fbin`` + ``query.fbin`` if present."""
+    disk_base = os.path.join(DATA_DIR, name, "base.fbin")
+    if os.path.exists(disk_base):
+        metric = _REGISTRY[name][3] if name in _REGISTRY else "euclidean"
+        return load_fbin_dataset(
+            name, disk_base, os.path.join(DATA_DIR, name, "query.fbin"), metric=metric
+        )
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown dataset {name!r}; known: {sorted(_REGISTRY)}")
+    n, dim, nq, metric = _REGISTRY[name]
+    return make_clustered(name, n, dim, nq, metric=metric, seed=seed)

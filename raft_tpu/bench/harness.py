@@ -1,0 +1,381 @@
+"""ANN benchmark harness — the L8 layer (SURVEY.md §2.8).
+
+Re-implements the reference's algorithm-agnostic bench in Python/JAX:
+
+* abstract build/search adapter per algorithm — the ``ANN<T>`` interface
+  (``cpp/bench/ann/src/common/ann_types.hpp:74,116``),
+* timed build and search loops with warmup, recall computed **in-harness**
+  against cached exact ground truth, QPS/latency counters
+  (``cpp/bench/ann/src/common/benchmark.hpp:120,175,379``),
+* the gbench-compatible JSON result schema (``items_per_second``,
+  ``Recall``, ``Latency``, ``end_to_end``, ``total_queries`` —
+  ``benchmark.hpp:330-385``) so the reference's data_export/plot tooling
+  ports directly,
+* param-grid sweeps + recall-constrained operating-point selection — the
+  orchestration of ``python/raft-ann-bench/src/raft_ann_bench/run/__main__.py:141``
+  with the ``run/conf/algos/*.yaml`` grid semantics.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.bench.datasets import Dataset
+
+# ---------------------------------------------------------------------------
+# algorithm adapters (ann_types.hpp:74 ANN<T>::build / ::search analog)
+# ---------------------------------------------------------------------------
+
+
+def _metric_of(ds: Dataset):
+    from raft_tpu.ops.distance import DistanceType
+
+    return DistanceType.InnerProduct if ds.metric == "inner_product" else DistanceType.L2Expanded
+
+
+def _build_brute_force(ds: Dataset, p: Dict[str, Any]):
+    from raft_tpu.neighbors import brute_force
+
+    return brute_force.build(ds.base, metric=_metric_of(ds))
+
+
+def _search_brute_force(index, queries, k: int, p: Dict[str, Any], batch: int):
+    from raft_tpu.neighbors import brute_force
+
+    return brute_force.search(
+        index,
+        queries,
+        k,
+        query_batch=batch,
+        mode=p.get("mode", "exact"),
+        recall_target=p.get("recall_target", 0.99),
+    )
+
+
+def _build_ivf_flat(ds: Dataset, p: Dict[str, Any]):
+    from raft_tpu.neighbors import ivf_flat
+
+    return ivf_flat.build(
+        ds.base,
+        ivf_flat.IvfFlatIndexParams(
+            n_lists=p.get("nlist", 1024),
+            metric=_metric_of(ds),
+            kmeans_n_iters=p.get("niter", 20),
+            kmeans_trainset_fraction=1.0 / p.get("ratio", 2),
+        ),
+    )
+
+
+def _search_ivf_flat(index, queries, k: int, p: Dict[str, Any], batch: int):
+    from raft_tpu.neighbors import ivf_flat
+
+    return ivf_flat.search(
+        index,
+        queries,
+        k,
+        ivf_flat.IvfFlatSearchParams(n_probes=p.get("nprobe", 20)),
+        query_batch=batch,
+    )
+
+
+def _build_ivf_pq(ds: Dataset, p: Dict[str, Any]):
+    from raft_tpu.neighbors import ivf_pq
+
+    return ivf_pq.build(
+        ds.base,
+        ivf_pq.IvfPqIndexParams(
+            n_lists=p.get("nlist", 1024),
+            metric=_metric_of(ds),
+            pq_dim=p.get("pq_dim", 0),
+            pq_bits=p.get("pq_bits", 8),
+            kmeans_n_iters=p.get("niter", 20),
+            kmeans_trainset_fraction=1.0 / p.get("ratio", 10),
+        ),
+    )
+
+
+def _search_ivf_pq(index, queries, k: int, p: Dict[str, Any], batch: int):
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import ivf_pq, refine as refine_mod
+
+    lut = {"float": jnp.float32, "half": jnp.bfloat16, "bf16": jnp.bfloat16, "fp8": jnp.bfloat16}[
+        p.get("smemLutDtype", "float")
+    ]
+    rr = p.get("refine_ratio", 1)
+    kk = k * rr
+    d, i = ivf_pq.search(
+        index,
+        queries,
+        kk,
+        ivf_pq.IvfPqSearchParams(n_probes=p.get("nprobe", 20), lut_dtype=lut),
+        query_batch=batch,
+    )
+    if rr > 1:
+        ds = p["_dataset"]  # injected by the runner for refine re-rank
+        d, i = refine_mod.refine(ds.base, queries, i, k, metric=_metric_of(ds))
+    return d, i
+
+
+def _build_cagra(ds: Dataset, p: Dict[str, Any]):
+    from raft_tpu.neighbors import cagra
+
+    return cagra.build(
+        ds.base,
+        cagra.CagraIndexParams(
+            intermediate_graph_degree=p.get("intermediate_graph_degree", 64),
+            graph_degree=p.get("graph_degree", 32),
+            build_algo=p.get("graph_build_algo", "NN_DESCENT"),
+            metric=_metric_of(ds),
+        ),
+    )
+
+
+def _search_cagra(index, queries, k: int, p: Dict[str, Any], batch: int):
+    from raft_tpu.neighbors import cagra
+
+    return cagra.search(
+        index,
+        queries,
+        k,
+        cagra.CagraSearchParams(
+            itopk_size=p.get("itopk", 64),
+            search_width=p.get("search_width", 1),
+            max_iterations=p.get("max_iterations", 0),
+        ),
+        query_batch=batch,
+    )
+
+
+ALGOS: Dict[str, Tuple[Callable, Callable]] = {
+    "raft_brute_force": (_build_brute_force, _search_brute_force),
+    "raft_ivf_flat": (_build_ivf_flat, _search_ivf_flat),
+    "raft_ivf_pq": (_build_ivf_pq, _search_ivf_pq),
+    "raft_cagra": (_build_cagra, _search_cagra),
+}
+
+
+# ---------------------------------------------------------------------------
+# result record (benchmark.hpp:330-385 counter schema)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BenchResult:
+    name: str
+    algo: str
+    dataset: str
+    k: int
+    batch: int
+    build_params: Dict[str, Any]
+    search_params: Dict[str, Any]
+    build_time: float
+    end_to_end: float  # total timed search seconds
+    iterations: int  # timed sweeps over the query set
+    total_queries: int
+    qps: float  # items_per_second
+    latency: float  # avg seconds per batch
+    recall: float
+
+    def to_json(self) -> Dict[str, Any]:
+        """One gbench-style benchmark entry (``benchmark.hpp:330-385``)."""
+        return {
+            "name": self.name,
+            "run_type": "iteration",
+            "iterations": self.iterations,
+            "real_time": self.end_to_end / max(self.iterations, 1),
+            "time_unit": "s",
+            "items_per_second": self.qps,
+            "Recall": self.recall,
+            "Latency": self.latency,
+            "end_to_end": self.end_to_end,
+            "total_queries": self.total_queries,
+            "build_time": self.build_time,
+            "k": self.k,
+            "n_queries": self.batch,
+            "algo": self.algo,
+            "dataset": self.dataset,
+            "build_params": self.build_params,
+            "search_params": self.search_params,
+        }
+
+
+def recall_at_k(found: np.ndarray, gt: np.ndarray, k: int) -> float:
+    """Set-overlap recall, the harness metric (``benchmark.hpp:346-379``)."""
+    found = found[:, :k]
+    gt = gt[:, :k]
+    hits = 0
+    for row_f, row_g in zip(found, gt):
+        hits += len(np.intersect1d(row_f, row_g, assume_unique=False))
+    return hits / float(gt.shape[0] * k)
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def _grid(space: Dict[str, Sequence[Any]]) -> Iterable[Dict[str, Any]]:
+    """Cartesian product of a {param: [values...]} grid (run/__main__.py:141)."""
+    if not space:
+        yield {}
+        return
+    keys = list(space)
+    for combo in itertools.product(*(space[key] for key in keys)):
+        yield dict(zip(keys, combo))
+
+
+def _fmt(params: Dict[str, Any]) -> str:
+    return ",".join(f"{k}={v}" for k, v in params.items() if not k.startswith("_")) or "default"
+
+
+def run_case(
+    ds: Dataset,
+    algo: str,
+    build_params: Dict[str, Any],
+    search_params_list: Sequence[Dict[str, Any]],
+    k: int = 10,
+    batch: int = 1024,
+    min_search_time: float = 2.0,
+    max_iterations: int = 20,
+    constraint: Optional[Callable[[Dict[str, Any], Dict[str, Any]], bool]] = None,
+    verbose: bool = True,
+) -> List[BenchResult]:
+    """Build once, then time every search-param point (the reference's
+    build/search phase split, ``benchmark.hpp:120,175``)."""
+    import jax
+
+    build_fn, search_fn = ALGOS[algo]
+    gt = ds.ground_truth(k)
+
+    t0 = time.perf_counter()
+    index = build_fn(ds, build_params)
+    jax.block_until_ready(index)  # whole pytree: include pack/encode work
+    build_time = time.perf_counter() - t0
+    if verbose:
+        print(f"# {algo} [{_fmt(build_params)}] built in {build_time:.1f}s", flush=True)
+
+    queries = ds.queries
+    nq = queries.shape[0]
+    # trim to whole batches: a trailing partial batch has a fresh jit shape
+    # whose compile would land inside the timed region
+    if nq > batch:
+        nq = (nq // batch) * batch
+        queries = queries[:nq]
+        gt = gt[:nq]
+    results = []
+    for sp in search_params_list:
+        if constraint is not None and not constraint(build_params, sp):
+            continue
+        sp = dict(sp)
+        sp["_dataset"] = ds
+        # warmup / compile
+        d, i = search_fn(index, queries[:batch] if nq >= batch else queries, k, sp, batch)
+        jax.block_until_ready((d, i))
+
+        # timed: sweep the query set repeatedly until min_search_time
+        iters = 0
+        total_q = 0
+        found = None
+        t0 = time.perf_counter()
+        while True:
+            outs = []
+            for s in range(0, nq, batch):
+                outs.append(search_fn(index, queries[s : s + batch], k, sp, batch))
+            jax.block_until_ready(outs[-1])
+            iters += 1
+            total_q += nq
+            if found is None:
+                found = np.concatenate([np.asarray(o[1]) for o in outs], axis=0)
+            if time.perf_counter() - t0 >= min_search_time or iters >= max_iterations:
+                break
+        end_to_end = time.perf_counter() - t0
+
+        rec = recall_at_k(found, gt, k)
+        n_batches = iters * -(-nq // batch)
+        res = BenchResult(
+            name=f"{algo}.{_fmt(build_params)}/{_fmt(sp)}/k={k}/batch={batch}",
+            algo=algo,
+            dataset=ds.name,
+            k=k,
+            batch=batch,
+            build_params=dict(build_params),
+            search_params={key: v for key, v in sp.items() if not key.startswith("_")},
+            build_time=build_time,
+            end_to_end=end_to_end,
+            iterations=iters,
+            total_queries=total_q,
+            qps=total_q / end_to_end,
+            latency=end_to_end / n_batches,
+            recall=rec,
+        )
+        results.append(res)
+        if verbose:
+            print(
+                f"  {_fmt(res.search_params):<40s} qps={res.qps:>12,.0f}  "
+                f"recall@{k}={rec:.4f}  lat={res.latency*1e3:.2f}ms",
+                flush=True,
+            )
+    return results
+
+
+def sweep(
+    ds: Dataset,
+    algo: str,
+    build_grid: Dict[str, Sequence[Any]],
+    search_grid: Dict[str, Sequence[Any]],
+    **kw,
+) -> List[BenchResult]:
+    """Full build-grid × search-grid sweep for one algorithm."""
+    out: List[BenchResult] = []
+    for bp in _grid(build_grid):
+        out.extend(run_case(ds, algo, bp, list(_grid(search_grid)), **kw))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# analysis (data_export / plot analogs)
+# ---------------------------------------------------------------------------
+
+
+def pareto_frontier(results: Sequence[BenchResult]) -> List[BenchResult]:
+    """Recall-QPS Pareto frontier (``raft_ann_bench/plot/__main__.py``)."""
+    pts = sorted(results, key=lambda r: (-r.recall, -r.qps))
+    front: List[BenchResult] = []
+    best_qps = -1.0
+    for r in pts:
+        if r.qps > best_qps:
+            front.append(r)
+            best_qps = r.qps
+    return list(reversed(front))
+
+
+def operating_point(results: Sequence[BenchResult], min_recall: float = 0.95) -> Optional[BenchResult]:
+    """Max-QPS configuration with recall >= threshold — the BASELINE.md
+    "QPS @ recall@10 = 0.95" operating point."""
+    ok = [r for r in results if r.recall >= min_recall]
+    return max(ok, key=lambda r: r.qps) if ok else None
+
+
+def to_report(results: Sequence[BenchResult], context: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """gbench-shaped JSON document {context, benchmarks}."""
+    import jax
+
+    ctx = {
+        "date": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "executable": "raft_tpu.bench",
+        "device": str(jax.devices()[0]),
+        "num_devices": len(jax.devices()),
+    }
+    ctx.update(context or {})
+    return {"context": ctx, "benchmarks": [r.to_json() for r in results]}
+
+
+def save_report(results: Sequence[BenchResult], path: str, context: Optional[Dict[str, Any]] = None) -> None:
+    with open(path, "w") as f:
+        json.dump(to_report(results, context), f, indent=2)

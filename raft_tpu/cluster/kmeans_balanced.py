@@ -25,6 +25,7 @@ reference's host-side loop at ``kmeans_balanced.cuh:988-1028``).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Optional, Tuple
 
@@ -55,10 +56,16 @@ class BalancedKMeansParams:
     balancing_threshold: float = 0.25  # re-seed clusters below avg*threshold
 
 
-def _weighted_lloyd(X, weights, init_centers, k: int, metric, n_iters: int):
+@functools.partial(jax.jit, static_argnames=("k", "n_iters", "metric"))
+def _weighted_lloyd(X, weights, init_centers, *, k: int, metric, n_iters: int):
     """Lloyd restricted to ``weights``-selected points (0/1 weights keep all
     shapes static — the TPU alternative to the reference's gather into a
-    per-mesocluster buffer at ``build_fine_clusters``)."""
+    per-mesocluster buffer at ``build_fine_clusters``).
+
+    Jitted with a static ``k``: callers must pad every run to one shared
+    ``k`` (see ``fit``) so the whole fine-cluster phase compiles ONCE —
+    per-mesocluster shapes would otherwise retrace/recompile for each of
+    the ~√k mesoclusters (~10 min of compile at 1M-scale builds)."""
 
     def body(_, centers):
         labels, _ = min_cluster_and_distance(X, centers, metric=metric)
@@ -180,17 +187,36 @@ def fit(
     while alloc.sum() < k:
         alloc[np.argmax(raw - alloc)] += 1
 
+    # For L2 metrics, all mesoclusters train at ONE padded k (k_pad = max
+    # allocation) so the jitted weighted-Lloyd compiles once; padding rows
+    # are parked at a far sentinel no point ever assigns to, so the kept
+    # centers converge exactly as an alloc[m]-sized run would — without
+    # per-mesocluster recompiles (which cost ~10 min at 1M-scale builds).
+    # No such sentinel exists for InnerProduct/Cosine assignment, so those
+    # metrics keep the per-mesocluster shapes (rare path; IVF builds train
+    # with L2).
+    l2_family = metric in (
+        DistanceType.L2Expanded,
+        DistanceType.L2SqrtExpanded,
+        DistanceType.L2Unexpanded,
+        DistanceType.L2SqrtUnexpanded,
+    )
+    k_pad = int(alloc.max())
     fine_centers = []
     w_all = jax.nn.one_hot(meso_labels, n_meso, dtype=jnp.float32)  # [nt, n_meso]
     for m in range(n_meso):
-        km = int(alloc[m])
+        km = k_pad if l2_family else int(alloc[m])
         kf, k_fine = jax.random.split(k_fine)
         weights = w_all[:, m]
         # Seed from points in this mesocluster: weighted sample via gumbel.
         g = jax.random.gumbel(kf, (nt,))
         seed_idx = lax.top_k(jnp.log(jnp.maximum(weights, 1e-30)) + g, km)[1]
         init = Xt[seed_idx]
-        fine_centers.append(_weighted_lloyd(Xt, weights, init, km, metric, 8))
+        if l2_family:
+            live = (jnp.arange(km) < int(alloc[m]))[:, None]
+            init = jnp.where(live, init, jnp.float32(1e30))
+        out = _weighted_lloyd(Xt, weights, init, k=km, metric=metric, n_iters=8)
+        fine_centers.append(out[: int(alloc[m])])
     centers = jnp.concatenate(fine_centers, axis=0)
 
     # -- phase 3: balancing EM over the full dataset ------------------------

@@ -39,7 +39,7 @@ from raft_tpu.ops.distance import (
     resolve_metric,
     row_norms,
 )
-from raft_tpu.ops.select_k import running_merge, select_k, worst_value
+from raft_tpu.ops.select_k import approx_select_k, running_merge, select_k, worst_value
 from raft_tpu.utils.math import cdiv
 
 _NORM_METRICS = frozenset(
@@ -170,6 +170,48 @@ def _search_impl(
     return vals, idx
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "select_min", "has_filter", "recall_target"),
+)
+def _search_approx_impl(
+    dataset,
+    norms,
+    queries_blocked,  # [n_blocks, block, d]
+    filter_mask,
+    *,
+    k: int,
+    metric: DistanceType,
+    select_min: bool,
+    has_filter: bool,
+    recall_target: float,
+):
+    """Fused-scan fast path: per query block, one MXU matmul over the FULL
+    dataset with the distance epilogue fused into an approximate top-k
+    (PartialReduce). XLA never materializes the [block, n] distance matrix,
+    so this runs at the matmul roofline — the TPU answer to the reference's
+    tiled-GEMM + select_k pipeline (``knn_brute_force.cuh:60``). All query
+    blocks ride one ``lax.scan`` inside one jit call: a single device
+    dispatch regardless of n_queries."""
+    worst = jnp.float32(worst_value(jnp.float32, select_min))
+
+    def step(_, q):
+        q_sqnorm = row_norms(q) if metric in _NORM_METRICS else None
+        dist = _expanded_distance(q, dataset, metric, q_sqnorm, norms)
+        if has_filter:
+            dist = jnp.where(filter_mask[None, :], dist, worst)
+        v, i = approx_select_k(
+            dist, k, select_min=select_min, recall_target=recall_target
+        )
+        # slots that only found worst-sentinel values (fewer than k rows
+        # pass the prefilter) return id -1, matching the exact path
+        i = jnp.where(v == worst, -1, i.astype(jnp.int32))
+        return None, (v, i)
+
+    _, (vals, idx) = lax.scan(step, None, queries_blocked)
+    return vals, idx
+
+
 def search(
     index: BruteForceIndex,
     queries,
@@ -177,14 +219,23 @@ def search(
     prefilter: Optional[Bitset] = None,
     query_batch: int = 4096,
     dataset_tile: Optional[int] = None,
+    mode: str = "exact",
+    recall_target: float = 0.99,
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Exact k-nearest-neighbor search.
+    """k-nearest-neighbor search.
 
     Analog of ``brute_force::search`` (``neighbors/brute_force-inl.cuh``).
     Returns ``(distances [n_queries, k] f32, indices [n_queries, k] i32)``,
     best-first. ``prefilter`` is a keep-bitset over dataset rows.
-    """
+
+    ``mode="exact"`` (default) reproduces the reference's exact contract
+    (tiled f32 scan + sort-based select). ``mode="approx"`` fuses the
+    distance matmul with TPU approximate top-k (see
+    :func:`raft_tpu.ops.select_k.approx_select_k`) — orders of magnitude
+    faster on large n, returning each true neighbor with probability
+    ``recall_target``; available for the expanded metrics
+    (L2/IP/cosine)."""
     res = ensure_resources(res)
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2, "queries must be [n_queries, dim]")
@@ -197,6 +248,33 @@ def search(
     metric = index.metric
     select_min = is_min_close(metric)
     nq = queries.shape[0]
+
+    if mode == "approx":
+        expects(
+            metric in _EXPANDED,
+            "approx mode needs a matmul-shaped (expanded) metric, got %s",
+            metric,
+        )
+        filter_mask = prefilter.to_mask() if prefilter is not None else None
+        block = min(query_batch, nq)
+        n_blocks = cdiv(nq, block)
+        pad = n_blocks * block - nq
+        qp = jnp.pad(queries, ((0, pad), (0, 0))) if pad else queries
+        v, i = _search_approx_impl(
+            index.dataset,
+            index.norms,
+            qp.reshape(n_blocks, block, index.dim),
+            filter_mask,
+            k=k,
+            metric=metric,
+            select_min=select_min,
+            has_filter=filter_mask is not None,
+            recall_target=recall_target,
+        )
+        v = v.reshape(n_blocks * block, k)[:nq]
+        i = i.reshape(n_blocks * block, k)[:nq]
+        return v, i
+    expects(mode == "exact", "mode must be 'exact' or 'approx', got %r", mode)
 
     if dataset_tile is None:
         # Size tiles so per-tile temporaries stay within the workspace budget

@@ -251,15 +251,14 @@ def build(
             pq, dataset, min(2 * top, pq.size), n_probes=32, query_batch=4096
         )
         _, nbrs = refine_fn(dataset, dataset, cand, top, metric=metric)
-        nbrs = np.asarray(nbrs)
-        rows = np.arange(n)[:, None]
         # drop self-edges, keep kin per row: stable argsort pushes the (at
-        # most one) self-edge per row to the end without a host loop
+        # most one) self-edge per row to the end — on device (shipping the
+        # [n, kin] graph through the host link costs minutes at 1M rows)
+        rows = jnp.arange(n, dtype=jnp.int32)[:, None]
         mask = nbrs != rows
-        pos = np.argsort(~mask, axis=1, kind="stable")[:, :kin]
-        knn = np.take_along_axis(nbrs, pos, axis=1).astype(np.int32)
-        knn = np.where(np.take_along_axis(mask, pos, axis=1), knn, -1)
-        knn_graph = jnp.asarray(knn)
+        pos = jnp.argsort(~mask, axis=1, stable=True)[:, :kin]
+        knn = jnp.take_along_axis(nbrs, pos, axis=1).astype(jnp.int32)
+        knn_graph = jnp.where(jnp.take_along_axis(mask, pos, axis=1), knn, -1)
 
     graph = optimize(knn_graph, kout)
     data_f32 = dataset.astype(jnp.float32)

@@ -44,10 +44,10 @@ from raft_tpu.core import serialize as ser
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.errors import expects
 from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.neighbors.ivf_common import pack_rows as _pack, topk_labels as _topk_labels
 from raft_tpu.ops.distance import DistanceType, is_min_close, resolve_metric, row_norms
 from raft_tpu.ops.fused_1nn import min_cluster_and_distance
 from raft_tpu.ops.select_k import running_merge, select_k, worst_value
-from raft_tpu.utils.math import round_up
 
 _SUPPORTED = (
     DistanceType.L2Expanded,
@@ -66,6 +66,11 @@ class IvfFlatIndexParams:
     kmeans_n_iters: int = 20
     kmeans_trainset_fraction: float = 0.5
     seed: int = 0
+    # Dense-layout list capacity: lists are capped at
+    # ``cap_factor * n / n_lists`` rows; overflow spills to the row's
+    # next-nearest lists (see ``ivf_common.assign_slots``). 0 disables
+    # capping (max_list = largest cluster, as the ragged reference layout).
+    list_cap_factor: float = 2.0
 
 
 @dataclasses.dataclass
@@ -87,16 +92,17 @@ class IvfFlatIndex:
     list_norms: Optional[jax.Array]  # [n_lists, max_list] f32 sq norms (L2/cos)
     metric: DistanceType
     size: int  # total indexed rows
+    list_cap_factor: float = 2.0  # build-time cap; honored by extend()
 
     def tree_flatten(self):
         return (
             (self.centers, self.list_data, self.list_indices, self.list_sizes, self.list_norms),
-            (self.metric, self.size),
+            (self.metric, self.size, self.list_cap_factor),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, metric=aux[0], size=aux[1])
+        return cls(*children, metric=aux[0], size=aux[1], list_cap_factor=aux[2])
 
     @property
     def n_lists(self) -> int:
@@ -109,35 +115,6 @@ class IvfFlatIndex:
     @property
     def max_list(self) -> int:
         return self.list_data.shape[1]
-
-
-def _pack_lists(dataset: jax.Array, labels: np.ndarray, n_lists: int, ids: np.ndarray):
-    """Pack rows into the dense [n_lists, max_list, d] layout.
-
-    Host-side packing at build time (the analog of the reference's
-    ``build_index_kernel`` scatter, ``ivf_flat_build.cuh:116``); sizes are
-    data-dependent so this is inherently a host decision point — one sync at
-    build, zero at search.
-    """
-    n, d = dataset.shape
-    counts = np.bincount(labels, minlength=n_lists)
-    max_list = max(8, round_up(int(counts.max()), 8))
-
-    order = np.argsort(labels, kind="stable")
-    within = np.arange(n) - np.repeat(np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
-    slots = labels[order] * max_list + within  # flat destination slot per row
-
-    flat_data = np.zeros((n_lists * max_list, d), dtype=np.asarray(dataset).dtype)
-    flat_ids = np.full((n_lists * max_list,), -1, np.int32)
-    ds_np = np.asarray(dataset)
-    flat_data[slots] = ds_np[order]
-    flat_ids[slots] = ids[order]
-    return (
-        jnp.asarray(flat_data.reshape(n_lists, max_list, d)),
-        jnp.asarray(flat_ids.reshape(n_lists, max_list)),
-        jnp.asarray(counts.astype(np.int32)),
-        max_list,
-    )
 
 
 def build(
@@ -179,11 +156,9 @@ def build(
             seed=params.seed,
         ),
     )
-    labels, _ = min_cluster_and_distance(assign_data, centers, metric=DistanceType.L2Expanded)
-
-    labels_np = np.asarray(labels)
-    list_data, list_indices, list_sizes, _ = _pack_lists(
-        dataset, labels_np, n_lists, np.arange(n, dtype=np.int32)
+    cand = _topk_labels(assign_data, centers)
+    list_data, list_indices, list_sizes, _ = _pack(
+        dataset, jnp.arange(n, dtype=jnp.int32), cand, n_lists, params.list_cap_factor
     )
     list_norms = None
     if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded, DistanceType.CosineExpanded):
@@ -196,39 +171,48 @@ def build(
         list_norms=list_norms,
         metric=metric,
         size=n,
+        list_cap_factor=params.list_cap_factor,
     )
 
 
-def extend(index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
+def extend(
+    index: IvfFlatIndex, new_vectors, new_ids=None, cap_factor: Optional[float] = None
+) -> IvfFlatIndex:
     """Add vectors to an existing index (``ivf_flat::extend``,
     ``detail/ivf_flat_build.cuh:163``): assign to nearest centers and repack
-    (centers are kept fixed, as in the reference)."""
+    on device (centers are kept fixed, as in the reference). Unlike the
+    round-2 implementation there is no device→host→device round trip — the
+    valid rows are gathered, concatenated with the new ones, and
+    re-scattered entirely on the accelerator. ``cap_factor=None`` uses the
+    index's build-time ``list_cap_factor``."""
+    if cap_factor is None:
+        cap_factor = index.list_cap_factor
     new_vectors = jnp.asarray(new_vectors)
     expects(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim, "bad extend shape")
     n_new = new_vectors.shape[0]
     if new_ids is None:
-        new_ids = np.arange(index.size, index.size + n_new, dtype=np.int32)
+        new_ids = jnp.arange(index.size, index.size + n_new, dtype=jnp.int32)
     else:
-        new_ids = np.asarray(new_ids, np.int32)
+        new_ids = jnp.asarray(new_ids, jnp.int32)
 
-    vec_f32 = new_vectors.astype(jnp.float32)
-    if index.metric == DistanceType.CosineExpanded:
-        vec_f32 = vec_f32 / jnp.maximum(jnp.linalg.norm(vec_f32, axis=1, keepdims=True), 1e-12)
-    labels, _ = min_cluster_and_distance(vec_f32, index.centers, metric=DistanceType.L2Expanded)
-
-    # Collect existing rows (valid slots), concat, repack.
     d = index.dim
-    old_mask = np.asarray(index.list_indices).reshape(-1) >= 0
-    old_data = np.asarray(index.list_data).reshape(-1, d)[old_mask]
-    old_ids = np.asarray(index.list_indices).reshape(-1)[old_mask]
-    old_labels = np.repeat(np.arange(index.n_lists), index.max_list)[old_mask]
+    # Compact existing valid rows to the front (on device): argsort on the
+    # invalid flag keeps list order among valid rows.
+    flat_ids = index.list_indices.reshape(-1)
+    n_old = int(index.size)
+    keep_order = jnp.argsort(flat_ids < 0)[:n_old]
+    old_data = index.list_data.reshape(-1, d)[keep_order]
+    old_ids = flat_ids[keep_order]
 
-    all_data = np.concatenate([old_data, np.asarray(new_vectors)], axis=0)
-    all_ids = np.concatenate([old_ids, new_ids])
-    all_labels = np.concatenate([old_labels, np.asarray(labels)])
+    all_data = jnp.concatenate([old_data, new_vectors.astype(index.list_data.dtype)], axis=0)
+    all_ids = jnp.concatenate([old_ids, new_ids])
+    assign = all_data.astype(jnp.float32)
+    if index.metric == DistanceType.CosineExpanded:
+        assign = assign / jnp.maximum(jnp.linalg.norm(assign, axis=1, keepdims=True), 1e-12)
+    cand = _topk_labels(assign, index.centers)
 
-    list_data, list_indices, list_sizes, _ = _pack_lists(
-        jnp.asarray(all_data), all_labels, index.n_lists, all_ids
+    list_data, list_indices, list_sizes, _ = _pack(
+        all_data, all_ids, cand, index.n_lists, cap_factor
     )
     list_norms = None
     if index.list_norms is not None:
@@ -241,7 +225,132 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
         list_norms=list_norms,
         metric=index.metric,
         size=index.size + n_new,
+        list_cap_factor=cap_factor,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "has_filter", "chunk_lists"),
+)
+def _ivf_flat_scan_impl(
+    centers,
+    list_data,
+    list_indices,
+    list_norms,
+    queries,
+    filter_bits,
+    *,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    has_filter: bool,
+    chunk_lists: int,
+):
+    """Dense masked scan — the TPU answer to the reference's fused
+    interleaved-scan kernel (``ivf_flat_interleaved_scan-inl.cuh:687``)
+    for batched queries.
+
+    Rather than gathering each query's probed lists (a per-(query,probe)
+    HBM gather that runs far off the roofline on TPU), the whole padded
+    index is streamed chunk-of-lists at a time through ONE dense MXU
+    matmul per chunk; rows in lists a query did not probe are masked with
+    an elementwise predicate that XLA fuses into the matmul epilogue, and
+    the selection is the fused approximate top-k. The candidate set is
+    exactly the probe path's. Wins whenever the query batch is large
+    enough that most lists are probed by someone (the usual
+    throughput-mode regime); ``search`` keeps the gather path for small
+    batches."""
+    nq, d = queries.shape
+    n_lists, max_list = list_indices.shape
+    qf = queries.astype(jnp.float32)
+    if metric == DistanceType.CosineExpanded:
+        qf = qf / jnp.maximum(jnp.linalg.norm(qf, axis=1, keepdims=True), 1e-12)
+
+    q_dot_c = qf @ centers.T
+    if metric == DistanceType.InnerProduct:
+        coarse = -q_dot_c
+    else:
+        c_norm = jnp.sum(centers * centers, axis=1)
+        coarse = c_norm[None, :] - 2.0 * q_dot_c
+    if n_probes < n_lists:
+        _, probes = select_k(coarse, n_probes, select_min=True)
+        probed = jnp.zeros((nq, n_lists), bool).at[
+            jnp.arange(nq)[:, None], probes
+        ].set(True)
+    else:
+        probed = jnp.ones((nq, n_lists), bool)
+
+    G, M = chunk_lists, max_list
+    n_chunks = n_lists // G
+    data_c = list_data.reshape(n_chunks, G * M, d)
+    ids_c = list_indices.reshape(n_chunks, G * M)
+    if list_norms is not None:
+        norms_c = list_norms.reshape(n_chunks, G * M)
+    else:
+        norms_c = jnp.zeros((n_chunks, G * M), jnp.float32)
+    probed_cm = jnp.moveaxis(probed.reshape(nq, n_chunks, G), 1, 0)
+
+    init = (
+        jnp.full((nq, k), -jnp.inf, jnp.float32),
+        jnp.zeros((nq, k), jnp.int32),  # flat slots
+    )
+
+    def body(carry, inp):
+        acc_v, acc_i = carry
+        rows, ids, nrm, pmask, ci = inp
+        dots = (qf @ rows.astype(jnp.float32).T).astype(jnp.float32)
+        if metric == DistanceType.InnerProduct:
+            score = dots
+        elif metric == DistanceType.CosineExpanded:
+            score = dots * lax.rsqrt(jnp.maximum(nrm, 1e-24))[None, :]
+        else:
+            score = 2.0 * dots - nrm[None, :]  # max == min L2
+        # Masking is ADDITIVE on the small axes (a [G*M] pad penalty and an
+        # [nq, G] probe penalty broadcast into the epilogue) — a boolean
+        # [nq, G*M] keep-mask defeats XLA's matmul fusion and costs ~10x.
+        pad_pen = jnp.where(ids >= 0, 0.0, -jnp.inf)  # [G*M]
+        if has_filter:
+            word = filter_bits[jnp.clip(ids, 0, None) // 32]
+            bit = (word >> (jnp.clip(ids, 0, None) % 32).astype(jnp.uint32)) & 1
+            pad_pen = jnp.where(bit == 1, pad_pen, -jnp.inf)
+        probe_pen = jnp.where(pmask, 0.0, -jnp.inf)  # [nq, G]
+        score = (
+            score
+            + pad_pen[None, :]
+            + jnp.broadcast_to(probe_pen[:, :, None], (nq, G, M)).reshape(nq, G * M)
+        )
+        # shortlist 2k per chunk: each true top-k member lands in the
+        # approximate top-2k with much higher probability than in the
+        # top-k, lifting end-to-end recall toward the probe path's
+        kk = min(max(2 * k, 16), G * M)
+        v, i = lax.approx_max_k(score, kk, recall_target=0.99)
+        nv, ni = lax.top_k(jnp.concatenate([acc_v, v], axis=1), k)
+        na = jnp.take_along_axis(
+            jnp.concatenate([acc_i, i + ci * (G * M)], axis=1), ni, axis=1
+        )
+        return (nv, na), None
+
+    (vals, slots), _ = lax.scan(
+        body,
+        init,
+        (data_c, ids_c, norms_c, probed_cm, jnp.arange(n_chunks, dtype=jnp.int32)),
+    )
+
+    idx = list_indices.reshape(-1)[slots.reshape(-1)].reshape(nq, k)
+    idx = jnp.where(jnp.isfinite(vals), idx, -1)
+    if metric == DistanceType.InnerProduct:
+        out = vals
+    elif metric == DistanceType.CosineExpanded:
+        out = 1.0 - vals
+        out = jnp.where(idx >= 0, out, jnp.inf)
+    else:
+        qn = jnp.sum(qf * qf, axis=1)
+        out = jnp.maximum(qn[:, None] - vals, 0.0)
+        if metric == DistanceType.L2SqrtExpanded:
+            out = jnp.sqrt(out)
+        out = jnp.where(idx >= 0, out, jnp.inf)
+    return out, idx
 
 
 @functools.partial(
@@ -334,13 +443,22 @@ def search(
     params: Optional[IvfFlatSearchParams] = None,
     prefilter: Optional[Bitset] = None,
     query_batch: int = 1024,
+    mode: str = "auto",
     res: Optional[Resources] = None,
     **kwargs,
 ) -> Tuple[jax.Array, jax.Array]:
     """ANN search over probed lists (``ivf_flat::search``,
     ``detail/ivf_flat_search-inl.cuh:271``). Returns best-first
     ``(distances [nq, k] f32, indices [nq, k] i32)``; unfilled slots get
-    id -1."""
+    id -1.
+
+    ``mode``: ``"scan"`` = dense masked scan over list chunks (the
+    TPU-fast throughput path, see :func:`_ivf_flat_scan_impl`);
+    ``"probe"`` = per-probe gather (latency path for small batches);
+    ``"auto"`` picks scan for batches >= 128 queries. Both draw from the
+    same probed candidate set, but the scan path selects with the fused
+    APPROXIMATE top-k (per-chunk recall target 0.99 on a 2k shortlist),
+    so results can differ slightly from the deterministic probe path."""
     ensure_resources(res)
     if params is None:
         params = IvfFlatSearchParams(**kwargs)
@@ -353,6 +471,44 @@ def search(
     nq = queries.shape[0]
 
     filter_bits = prefilter.bits if prefilter is not None else None
+
+    if mode == "auto":
+        mode = "scan" if nq >= 128 else "probe"
+    expects(mode in ("scan", "probe"), "mode must be auto|scan|probe, got %r", mode)
+    if mode == "scan":
+        # ~512k rows per chunk: measured sweet spot for the fused
+        # matmul+mask+approx-select pipeline (small chunks hit XLA fusion
+        # cliffs where the probed mask materializes)
+        g = max(1, 524288 // max(index.max_list, 1))
+        while index.n_lists % g:
+            g -= 1
+        out_v, out_i = [], []
+        for start in range(0, nq, query_batch):
+            qc = queries[start : start + query_batch]
+            bpad = 0
+            if qc.shape[0] < query_batch and nq > query_batch:
+                bpad = query_batch - qc.shape[0]
+                qc = jnp.pad(qc, ((0, bpad), (0, 0)))
+            v, i = _ivf_flat_scan_impl(
+                index.centers,
+                index.list_data,
+                index.list_indices,
+                index.list_norms,
+                qc,
+                filter_bits,
+                k=k,
+                n_probes=n_probes,
+                metric=index.metric,
+                has_filter=filter_bits is not None,
+                chunk_lists=g,
+            )
+            if bpad:
+                v, i = v[:-bpad], i[:-bpad]
+            out_v.append(v)
+            out_i.append(i)
+        if len(out_v) == 1:
+            return out_v[0], out_i[0]
+        return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
 
     out_v, out_i = [], []
     for start in range(0, nq, query_batch):
@@ -385,13 +541,14 @@ def search(
 # -- serialization (neighbors/ivf_flat_serialize.cuh analog) ----------------
 
 _KIND = "ivf_flat"
-_VERSION = 1
+_VERSION = 2
 
 
 def save(index: IvfFlatIndex, stream: BinaryIO) -> None:
     ser.dump_header(stream, _KIND, _VERSION)
     ser.serialize_scalar(stream, int(index.metric), "int32")
     ser.serialize_scalar(stream, int(index.size), "int64")
+    ser.serialize_scalar(stream, float(index.list_cap_factor), "float64")
     ser.serialize_scalar(stream, int(index.list_norms is not None), "int32")
     ser.serialize_array(stream, index.centers)
     ser.serialize_array(stream, index.list_data)
@@ -403,9 +560,10 @@ def save(index: IvfFlatIndex, stream: BinaryIO) -> None:
 
 def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfFlatIndex:
     ensure_resources(res)
-    ser.check_header(stream, _KIND)
+    version = ser.check_header(stream, _KIND)
     metric = DistanceType(ser.deserialize_scalar(stream, "int32"))
     size = int(ser.deserialize_scalar(stream, "int64"))
+    cap_factor = float(ser.deserialize_scalar(stream, "float64")) if version >= 2 else 2.0
     has_norms = bool(ser.deserialize_scalar(stream, "int32"))
     centers = ser.deserialize_array(stream)
     list_data = ser.deserialize_array(stream)
@@ -420,4 +578,5 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfFlatIndex:
         list_norms=list_norms,
         metric=metric,
         size=size,
+        list_cap_factor=cap_factor,
     )
