@@ -22,6 +22,7 @@ TPU design notes:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -215,3 +216,133 @@ def transform(X, centroids, metric=DistanceType.L2Expanded) -> jax.Array:
 def inertia(X, centroids, metric=DistanceType.L2Expanded) -> jax.Array:
     _, dists = predict(X, centroids, metric)
     return jnp.sum(dists)
+
+
+def cluster_dispersion(centroids, cluster_sizes) -> jax.Array:
+    """Cluster dispersion metric (``stats/dispersion.cuh:85``): sqrt of the
+    weighted sum of squared distances between centroids and the global
+    (size-weighted) centroid."""
+    c = jnp.asarray(centroids, jnp.float32)
+    w = jnp.asarray(cluster_sizes, jnp.float32)
+    total = jnp.maximum(jnp.sum(w), 1.0)
+    g = jnp.sum(c * w[:, None], axis=0) / total
+    return jnp.sqrt(jnp.sum(w * jnp.sum((c - g) ** 2, axis=1)))
+
+
+def find_k(
+    X,
+    kmax: int,
+    kmin: int = 1,
+    max_iter: int = 100,
+    tol: float = 1e-2,
+    seed: int = 0,
+) -> Tuple[int, jax.Array, jax.Array]:
+    """Auto-select k — ``kmeans::find_k`` (``cluster/kmeans.cuh:291-308``,
+    ``detail/kmeans_auto_find_k.cuh:67``).
+
+    Binary search over k maximizing the Calinski-Harabasz-style objective
+    ``(n - k) / (k - 1) * dispersion(k) / inertia(k)`` exactly as the
+    reference's bisection does (slope test on the objective at
+    left/mid/right). Returns ``(best_k, inertia, n_iter)``.
+    """
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    expects(1 <= kmin <= kmax <= n, "need 1 <= kmin <= kmax <= n")
+    params = lambda k: KMeansParams(n_clusters=k, max_iter=max_iter, tol=tol, seed=seed)
+
+    cache = {}
+
+    def objective(k):
+        if k not in cache:
+            out = fit(X, params(k))
+            sizes = jnp.zeros((k,), jnp.int32).at[out.labels].add(1)
+            disp = cluster_dispersion(out.centroids, sizes)
+            inert = jnp.maximum(out.inertia, 1e-20)
+            obj = (n - k) / max(k - 1, 1) * float(disp) / float(inert)
+            cache[k] = (obj, out)
+        return cache[k]
+
+    left = max(2, kmin)
+    right = kmax
+    if left >= right:
+        _, out = objective(right)
+        return right, out.inertia, out.n_iter
+    if right - left <= 24:
+        # small range: evaluate exhaustively (each fit is cached; the
+        # reference's slope-sign bisection walks the wrong way when the
+        # objective is monotone, e.g. true k at kmin)
+        best = max(range(left, right + 1), key=lambda k: objective(k)[0])
+        _, out = objective(best)
+        return best, out.inertia, out.n_iter
+    while right - left > 2:
+        m1 = left + (right - left) // 3
+        m2 = right - (right - left) // 3
+        if objective(m1)[0] < objective(m2)[0]:
+            left = m1 + 1
+        else:
+            right = m2 - 1
+    best = max(range(left, right + 1), key=lambda k: objective(k)[0])
+    _, out = objective(best)
+    return best, out.inertia, out.n_iter
+
+
+def fit_minibatch(
+    X,
+    params: Optional[KMeansParams] = None,
+    n_epochs: int = 10,
+    res: Optional[Resources] = None,
+    **kwargs,
+) -> KMeansOutput:
+    """Mini-batch Lloyd — the ``batch_samples`` tiling of
+    ``kmeans_types.hpp:102-106`` taken to its stochastic conclusion: each
+    step assigns one ``batch_samples``-sized sample and moves its centers
+    by the running-count learning rate (centers never see a full [n, k]
+    anything; peak memory is O(batch * d + batch * k_tile)).
+
+    Use for n >> HBM; plain :func:`fit` already tiles its E step and is
+    preferred when the data fits."""
+    res = ensure_resources(res)
+    if params is None:
+        params = KMeansParams(**kwargs)
+    metric = resolve_metric(params.metric)
+    X = jnp.asarray(X, jnp.float32)
+    n, d = X.shape
+    k = params.n_clusters
+    b = int(min(params.batch_samples, n))
+    expects(0 < k <= b, "n_clusters=%d must be <= batch_samples=%d", k, b)
+
+    key = as_key(params.seed)
+    key, kinit = jax.random.split(key)
+    init_idx = jax.random.permutation(kinit, n)[:b]
+    centers = kmeans_plus_plus(kinit, X[init_idx], k)
+
+    steps = max(1, n_epochs * (n // b))
+
+    @functools.partial(jax.jit, static_argnames=())
+    def step(carry, kk):
+        centers, counts = carry
+        idx = jax.random.randint(kk, (b,), 0, n)
+        batch = X[idx]
+        labels, _ = min_cluster_and_distance(batch, centers, metric=metric)
+        bsum = jax.ops.segment_sum(batch, labels, num_segments=k)
+        bcnt = jax.ops.segment_sum(jnp.ones((b,), jnp.float32), labels, num_segments=k)
+        new_counts = counts + bcnt
+        # per-center learning rate = batch count / total count (sklearn's
+        # MiniBatchKMeans update; equivalent to a running weighted mean)
+        lr = jnp.where(new_counts > 0, bcnt / jnp.maximum(new_counts, 1.0), 0.0)
+        bmean = bsum / jnp.maximum(bcnt[:, None], 1e-9)
+        centers = jnp.where(
+            (bcnt > 0)[:, None], centers + lr[:, None] * (bmean - centers), centers
+        )
+        return (centers, new_counts), None
+
+    keys = jax.random.split(key, steps)
+    (centers, _), _ = lax.scan(step, (centers, jnp.zeros((k,), jnp.float32)), keys)
+
+    labels, dists = min_cluster_and_distance(X, centers, metric=metric)
+    return KMeansOutput(
+        centroids=centers,
+        labels=labels,
+        inertia=jnp.sum(dists),
+        n_iter=jnp.int32(steps),
+    )

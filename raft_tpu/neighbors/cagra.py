@@ -381,6 +381,15 @@ def _cagra_search_impl(
     return vals, idx
 
 
+def derive_search_config(params: "CagraSearchParams", k: int, size: int):
+    """(itopk, width, iters, n_init) from search params — the
+    ``search_plan.cuh:136`` adjust step, shared with the sharded path."""
+    itopk = max(params.itopk_size, k)
+    width = max(1, params.search_width)
+    iters = params.max_iterations or max(10, itopk // max(1, width))
+    return itopk, width, iters, min(itopk, size)
+
+
 def search(
     index: CagraIndex,
     queries,
@@ -400,16 +409,13 @@ def search(
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "bad query shape")
     expects(k >= 1, "k must be >= 1")
-    itopk = max(params.itopk_size, k)
-    width = max(1, params.search_width)
     # auto iteration count (search_plan.cuh:136 adjust_search_params)
-    iters = params.max_iterations or max(10, itopk // max(1, width))
+    itopk, width, iters, n_init = derive_search_config(params, k, index.size)
     if prefilter is not None:
         expects(prefilter.size >= index.size, "prefilter smaller than index")
     filter_bits = prefilter.bits if prefilter is not None else None
 
     nq = queries.shape[0]
-    n_init = min(itopk, index.size)
     key = as_key(params.seed)
 
     out_v, out_i = [], []

@@ -261,12 +261,39 @@ def _ivf_flat_scan_impl(
     enough that most lists are probed by someone (the usual
     throughput-mode regime); ``search`` keeps the gather path for small
     batches."""
-    nq, d = queries.shape
-    n_lists, max_list = list_indices.shape
     qf = queries.astype(jnp.float32)
     if metric == DistanceType.CosineExpanded:
         qf = qf / jnp.maximum(jnp.linalg.norm(qf, axis=1, keepdims=True), 1e-12)
+    probed = probe_mask(centers, qf, n_probes, metric)
+    return flat_scan_core(
+        list_data,
+        list_indices,
+        list_norms,
+        qf,
+        probed,
+        filter_bits,
+        k=k,
+        metric=metric,
+        has_filter=has_filter,
+        chunk_lists=chunk_lists,
+    )
 
+
+def scan_chunk_lists(n_lists: int, max_list: int) -> int:
+    """Chunk-of-lists size for the dense scan: ~512k rows per chunk (the
+    measured fusion sweet spot), constrained to divide n_lists."""
+    g = max(1, 524288 // max(max_list, 1))
+    while n_lists % g:
+        g -= 1
+    return g
+
+
+def probe_mask(centers, qf, n_probes: int, metric: DistanceType) -> jax.Array:
+    """[nq, n_lists] bool — which lists each query probes (the coarse
+    ``select_clusters`` step as a mask). For cosine, ``qf`` must already be
+    unit-normalized."""
+    nq = qf.shape[0]
+    n_lists = centers.shape[0]
     q_dot_c = qf @ centers.T
     if metric == DistanceType.InnerProduct:
         coarse = -q_dot_c
@@ -275,12 +302,31 @@ def _ivf_flat_scan_impl(
         coarse = c_norm[None, :] - 2.0 * q_dot_c
     if n_probes < n_lists:
         _, probes = select_k(coarse, n_probes, select_min=True)
-        probed = jnp.zeros((nq, n_lists), bool).at[
+        return jnp.zeros((nq, n_lists), bool).at[
             jnp.arange(nq)[:, None], probes
         ].set(True)
-    else:
-        probed = jnp.ones((nq, n_lists), bool)
+    return jnp.ones((nq, n_lists), bool)
 
+
+def flat_scan_core(
+    list_data,
+    list_indices,
+    list_norms,
+    qf,
+    probed,
+    filter_bits,
+    *,
+    k: int,
+    metric: DistanceType,
+    has_filter: bool,
+    chunk_lists: int,
+):
+    """Masked dense scan over (a shard of) the padded lists. ``probed`` is
+    [nq, n_lists_local]; ``list_indices`` carry global row ids, so per-shard
+    results merge directly (used by ``parallel.sharded_ann``)."""
+    nq = qf.shape[0]
+    n_lists, max_list = list_indices.shape
+    d = list_data.shape[-1]
     G, M = chunk_lists, max_list
     n_chunks = n_lists // G
     data_c = list_data.reshape(n_chunks, G * M, d)
@@ -476,12 +522,7 @@ def search(
         mode = "scan" if nq >= 128 else "probe"
     expects(mode in ("scan", "probe"), "mode must be auto|scan|probe, got %r", mode)
     if mode == "scan":
-        # ~512k rows per chunk: measured sweet spot for the fused
-        # matmul+mask+approx-select pipeline (small chunks hit XLA fusion
-        # cliffs where the probed mask materializes)
-        g = max(1, 524288 // max(index.max_list, 1))
-        while index.n_lists % g:
-            g -= 1
+        g = scan_chunk_lists(index.n_lists, index.max_list)
         out_v, out_i = [], []
         for start in range(0, nq, query_batch):
             qc = queries[start : start + query_batch]

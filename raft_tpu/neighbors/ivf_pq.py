@@ -782,6 +782,25 @@ def _ivf_pq_search_impl(
     return vals, idx
 
 
+def scan_chunk_lists(n_lists: int, max_list: int) -> int:
+    """Chunk size for the decode scan: ~256k rows (decode temporaries are
+    [rows, pq_dim, ksub]-shaped, so PQ chunks stay smaller than the flat
+    scan's), constrained to divide n_lists."""
+    g = max(1, 262144 // max(max_list, 1))
+    while n_lists % g:
+        g -= 1
+    return g
+
+
+def scan_bf16(lut_dtype) -> bool:
+    """Reduced-precision decode/score is a TPU-only mode (the CPU dot
+    thunk has no bf16 support)."""
+    return (
+        jnp.dtype(lut_dtype) == jnp.dtype(jnp.bfloat16)
+        and jax.default_backend() == "tpu"
+    )
+
+
 def search(
     index: IvfPqIndex,
     queries,
@@ -823,12 +842,7 @@ def search(
     expects(mode in ("scan", "probe"), "mode must be auto|scan|probe, got %r", mode)
 
     if mode == "scan":
-        # ~256k rows per chunk, dividing n_lists (decode temporaries are
-        # [rows, pq_dim, ksub]-shaped, so PQ chunks stay smaller than the
-        # flat scan's)
-        g = max(1, 262144 // max(index.max_list, 1))
-        while index.n_lists % g:
-            g -= 1
+        g = scan_chunk_lists(index.n_lists, index.max_list)
         out_v, out_i = [], []
         for start in range(0, nq, query_batch):
             qc = queries[start : start + query_batch]
@@ -852,10 +866,7 @@ def search(
                 per_cluster=index.codebook_kind == PER_CLUSTER,
                 has_filter=filter_bits is not None,
                 chunk_lists=g,
-                # CPU's dot thunk lacks bf16 support; reduced precision is
-                # a TPU-only mode
-                bf16=jnp.dtype(params.lut_dtype) == jnp.dtype(jnp.bfloat16)
-                and jax.default_backend() == "tpu",
+                bf16=scan_bf16(params.lut_dtype),
             )
             if bpad:
                 v, i = v[:-bpad], i[:-bpad]

@@ -12,9 +12,27 @@ from raft_tpu.ops.distance import (
     row_norms,
 )
 from raft_tpu.ops.fused_1nn import fused_l2_nn, min_cluster_and_distance
+from raft_tpu.ops.kernels import (
+    KernelParams,
+    KernelType,
+    gram_matrix,
+    linear_kernel,
+    polynomial_kernel,
+    rbf_kernel,
+    tanh_kernel,
+)
+from raft_tpu.ops.masked_nn import masked_l2_nn
 from raft_tpu.ops.select_k import merge_parts, running_merge, select_k, worst_value
 
 __all__ = [
+    "KernelParams",
+    "KernelType",
+    "gram_matrix",
+    "linear_kernel",
+    "masked_l2_nn",
+    "polynomial_kernel",
+    "rbf_kernel",
+    "tanh_kernel",
     "DistanceType",
     "is_min_close",
     "pairwise_distance",

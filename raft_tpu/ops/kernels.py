@@ -1,0 +1,83 @@
+"""Kernel gram matrices — analog of ``raft::distance::kernels``
+(``distance/detail/kernels/gram_matrix.cuh:52`` ``GramMatrixBase``,
+``kernel_matrices.cuh`` ``PolynomialKernel``/``TanhKernel``/``RBFKernel``,
+``kernel_factory.cuh`` dispatch on ``KernelParams``).
+
+Every kernel is one MXU matmul (or the expanded-L2 matmul for RBF) plus a
+fused elementwise epilogue — the natural TPU shape of the reference's
+cuBLAS-gemm-plus-epilogue design.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+from raft_tpu.ops.distance import DistanceType, pairwise_distance
+
+
+class KernelType(enum.IntEnum):
+    """``KernelType`` enum (``kernel_factory.cuh``)."""
+
+    LINEAR = 0
+    POLYNOMIAL = 1
+    RBF = 2
+    TANH = 3
+
+
+@dataclasses.dataclass
+class KernelParams:
+    """``KernelParams`` analog: (kernel, degree, gamma, coef0)."""
+
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+def linear_kernel(x, y) -> jax.Array:
+    """x @ y^T (``GramMatrixBase::linear``, ``gram_matrix.cuh``)."""
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    return x @ y.T
+
+
+def polynomial_kernel(x, y, degree: int = 3, gamma: float = 1.0, coef0: float = 0.0) -> jax.Array:
+    """(gamma x.y + coef0)^degree (``PolynomialKernel``,
+    ``kernel_matrices.cuh:153``)."""
+    return (gamma * linear_kernel(x, y) + coef0) ** degree
+
+
+def tanh_kernel(x, y, gamma: float = 1.0, coef0: float = 0.0) -> jax.Array:
+    """tanh(gamma x.y + coef0) (``TanhKernel``, ``kernel_matrices.cuh:329``)."""
+    return jnp.tanh(gamma * linear_kernel(x, y) + coef0)
+
+
+def rbf_kernel(x, y, gamma: float = 1.0) -> jax.Array:
+    """exp(-gamma ||x - y||^2) (``RBFKernel``, ``kernel_matrices.cuh:497``;
+    distances via the expanded-L2 matmul + ``rbf_fin_op.cuh`` epilogue)."""
+    d2 = pairwise_distance(x, y, DistanceType.L2Expanded)
+    return jnp.exp(-gamma * d2)
+
+
+def gram_matrix(x, y: Optional[jax.Array] = None, params: Optional[KernelParams] = None, **kwargs) -> jax.Array:
+    """Evaluate the gram matrix for ``params.kernel`` — the
+    ``KernelFactory::create(params)`` + ``operator()`` path
+    (``kernel_factory.cuh:30``). ``y=None`` means the symmetric gram of
+    ``x`` with itself."""
+    if params is None:
+        params = KernelParams(**kwargs)
+    y = x if y is None else y
+    k = KernelType(params.kernel)
+    if k == KernelType.LINEAR:
+        return linear_kernel(x, y)
+    if k == KernelType.POLYNOMIAL:
+        return polynomial_kernel(x, y, params.degree, params.gamma, params.coef0)
+    if k == KernelType.TANH:
+        return tanh_kernel(x, y, params.gamma, params.coef0)
+    expects(k == KernelType.RBF, "unknown kernel %s", k)
+    return rbf_kernel(x, y, params.gamma)

@@ -1,0 +1,114 @@
+"""Masked L2 1-nearest-neighbor — analog of
+``raft::distance::masked_l2_nn`` (``distance/masked_nn.cuh:39``; params
+struct ``masked_l2_nn_params`` at ``:67``).
+
+The reference skips whole (x-tile, y-group) distance tiles when the
+adjacency bit is off — a compute-skipping win for HDBSCAN-class consumers
+(cross-component nearest neighbors). On the MXU, dense tiles beat
+data-dependent skipping at these shapes, so the TPU form computes the
+tiled fused distance+argmin (the :mod:`raft_tpu.ops.fused_1nn` engine)
+and applies the group mask as an additive -inf/+inf epilogue that XLA
+fuses into the matmul — the same *semantics* (only adjacent groups
+compete) with dense scheduling.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.ops.distance import row_norms
+from raft_tpu.utils.math import cdiv
+
+
+@functools.partial(jax.jit, static_argnames=("sqrt", "tile"))
+def _masked_l2_nn_impl(x, y, xn, yn, adj, group_ids, *, sqrt: bool, tile: int):
+    m, d = x.shape
+    n = y.shape[0]
+    n_tiles = cdiv(n, tile)
+    pad = n_tiles * tile - n
+    yp = jnp.pad(y, ((0, pad), (0, 0))) if pad else y
+    ynp = jnp.pad(yn, (0, pad)) if pad else yn
+    gp = jnp.pad(group_ids, (0, pad), constant_values=0) if pad else group_ids
+    validp = jnp.arange(n_tiles * tile) < n
+
+    y_t = yp.reshape(n_tiles, tile, d)
+    yn_t = ynp.reshape(n_tiles, tile)
+    g_t = gp.reshape(n_tiles, tile)
+    v_t = validp.reshape(n_tiles, tile)
+
+    init = (jnp.full((m,), jnp.inf, jnp.float32), jnp.full((m,), -1, jnp.int32))
+
+    def body(carry, inp):
+        best_v, best_i = carry
+        t, yt, ynt, gt, vt = inp
+        dot = lax.dot_general(
+            x, yt, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dist = xn[:, None] + ynt[None, :] - 2.0 * dot
+        dist = jnp.maximum(dist, 0.0)
+        # additive mask: adj[i, group(j)] off or padded slot -> +inf
+        allowed = adj[:, gt]  # [m, tile] via gather on the small group axis
+        pen = jnp.where(vt[None, :] & allowed, 0.0, jnp.inf)
+        dist = dist + pen
+        tv = jnp.min(dist, axis=1)
+        ti = jnp.argmin(dist, axis=1).astype(jnp.int32) + t * tile
+        take = tv < best_v
+        return (
+            jnp.where(take, tv, best_v),
+            jnp.where(take, ti, best_i),
+        ), None
+
+    (best_v, best_i), _ = lax.scan(
+        body, init, (jnp.arange(n_tiles), y_t, yn_t, g_t, v_t)
+    )
+    best_i = jnp.where(jnp.isfinite(best_v), best_i, -1)
+    if sqrt:
+        best_v = jnp.sqrt(jnp.maximum(best_v, 0.0))
+    best_v = jnp.where(best_i >= 0, best_v, jnp.inf)
+    return best_v, best_i
+
+
+def masked_l2_nn(
+    x,
+    y,
+    adj,
+    group_idxs,
+    x_sqnorm: Optional[jax.Array] = None,
+    y_sqnorm: Optional[jax.Array] = None,
+    sqrt: bool = False,
+    tile: int = 4096,
+) -> Tuple[jax.Array, jax.Array]:
+    """For each row of ``x``, the (distance, index) of its nearest row of
+    ``y`` among the *adjacent groups only*.
+
+    Mirrors ``masked_l2_nn`` (``distance/masked_nn.cuh:39``): ``y`` rows
+    are partitioned into contiguous groups whose END indices are
+    ``group_idxs`` (``group_idxs[k]`` = one past the last row of group k,
+    as in the reference), and ``adj [m, num_groups]`` says which groups
+    each ``x`` row may connect to. Rows with no adjacent group return
+    ``(inf, -1)`` (the reference's maxVal/-1 init).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    adj = jnp.asarray(adj, bool)
+    group_idxs = jnp.asarray(group_idxs, jnp.int32)
+    expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1], "bad x/y shapes")
+    m, n = x.shape[0], y.shape[0]
+    num_groups = group_idxs.shape[0]
+    expects(adj.shape == (m, num_groups), "adj must be [m, num_groups]")
+
+    # group id per y row from the END indices: row j belongs to the first
+    # group whose end exceeds j
+    group_ids = jnp.searchsorted(group_idxs, jnp.arange(n, dtype=jnp.int32), side="right").astype(jnp.int32)
+    group_ids = jnp.clip(group_ids, 0, num_groups - 1)
+
+    xn = row_norms(x) if x_sqnorm is None else jnp.asarray(x_sqnorm, jnp.float32)
+    yn = row_norms(y) if y_sqnorm is None else jnp.asarray(y_sqnorm, jnp.float32)
+    return _masked_l2_nn_impl(
+        x, y, xn, yn, adj, group_ids, sqrt=sqrt, tile=int(min(tile, max(n, 8)))
+    )

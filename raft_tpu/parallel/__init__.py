@@ -20,9 +20,17 @@ from raft_tpu.parallel.comms import (
     replicated,
     row_sharded,
 )
+from raft_tpu.parallel.sharded_ann import (
+    sharded_cagra_search,
+    sharded_ivf_flat_search,
+    sharded_ivf_pq_search,
+)
 from raft_tpu.parallel.sharded_knn import sharded_knn
 
 __all__ = [
+    "sharded_cagra_search",
+    "sharded_ivf_flat_search",
+    "sharded_ivf_pq_search",
     "DEFAULT_AXIS",
     "allgather",
     "allreduce",

@@ -1,0 +1,200 @@
+"""ICI-sharded ANN search for the IVF and CAGRA indexes.
+
+SURVEY.md §7 step 7 / §2.5: the reference leaves multi-GPU ANN to
+downstream consumers (``docs/source/using_raft_comms.rst:5-7``); this
+framework ships it in-tree. Two shardings, mirroring how the data
+structures scale:
+
+* **IVF-Flat: inverted lists sharded** across the mesh axis. Coarse
+  probing runs against the replicated centers (tiny), each shard streams
+  only its slice of the padded lists through the dense masked scan
+  (:func:`raft_tpu.neighbors.ivf_flat.flat_scan_core`) — list ids in the
+  padded layout are global dataset row ids, so per-shard top-k merge with
+  one ``all_gather`` + k-way merge (``knn_merge_parts`` pattern).
+* **CAGRA / IVF-PQ: queries sharded, index replicated** — graph beam
+  search is latency-bound per query and the graph is compact, so
+  replicated-index data parallelism is the first-order scaling knob (the
+  reference's multi-GPU story for CAGRA is likewise index-replica
+  sharding at the serving layer).
+
+Everything runs under ``shard_map`` over a :func:`make_mesh` mesh and
+works identically on real ICI or the virtual CPU test mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.core.errors import expects
+from raft_tpu.neighbors import cagra as cagra_mod, ivf_flat as ivf_flat_mod, ivf_pq as ivf_pq_mod
+from raft_tpu.ops.distance import DistanceType
+from raft_tpu.ops.select_k import merge_parts
+from raft_tpu.random.rng import as_key
+
+
+def sharded_ivf_flat_search(
+    mesh: Mesh,
+    index: "ivf_flat_mod.IvfFlatIndex",
+    queries,
+    k: int,
+    params: Optional["ivf_flat_mod.IvfFlatSearchParams"] = None,
+    axis: str = "data",
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """IVF-Flat search with lists sharded over ``mesh`` axis ``axis``.
+
+    Returns replicated ``(distances [nq, k], indices [nq, k])`` drawn from
+    the same probed candidate set as single-device scan search.
+    """
+    if params is None:
+        params = ivf_flat_mod.IvfFlatSearchParams(**kwargs)
+    queries = jnp.asarray(queries, jnp.float32)
+    n_shards = mesh.shape[axis]
+    L = index.n_lists
+    expects(L % n_shards == 0, "n_lists %d not divisible by %d shards", L, n_shards)
+    l_local = L // n_shards
+    n_probes = min(params.n_probes, L)
+    metric = index.metric
+    g = ivf_flat_mod.scan_chunk_lists(l_local, index.max_list)
+
+    def local(centers, ld, li, ln, q):
+        rank = lax.axis_index(axis)
+        qf = q
+        if metric == DistanceType.CosineExpanded:
+            qf = qf / jnp.maximum(jnp.linalg.norm(qf, axis=1, keepdims=True), 1e-12)
+        probed = ivf_flat_mod.probe_mask(centers, qf, n_probes, metric)
+        probed_local = lax.dynamic_slice_in_dim(probed, rank * l_local, l_local, axis=1)
+        v, i = ivf_flat_mod.flat_scan_core(
+            ld, li, ln, qf, probed_local, None,
+            k=k, metric=metric, has_filter=False, chunk_lists=g,
+        )
+        all_v = jax.lax.all_gather(v, axis)
+        all_i = jax.lax.all_gather(i, axis)
+        nq = q.shape[0]
+        cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
+        cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
+        select_min = metric != DistanceType.InnerProduct
+        # invalid (-1) slots carry +/-inf values and lose the merge
+        return merge_parts(cat_v, cat_i, k, select_min=select_min)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    ln = index.list_norms
+    if ln is None:
+        ln = jnp.zeros(index.list_indices.shape, jnp.float32)
+    put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.jit(fn)(
+        put(index.centers, P()),
+        put(index.list_data, P(axis)),
+        put(index.list_indices, P(axis)),
+        put(ln, P(axis)),
+        put(queries, P()),
+    )
+
+
+def sharded_cagra_search(
+    mesh: Mesh,
+    index: "cagra_mod.CagraIndex",
+    queries,
+    k: int,
+    params: Optional["cagra_mod.CagraSearchParams"] = None,
+    axis: str = "data",
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """CAGRA beam search with queries sharded over the mesh (replicated
+    graph + dataset). Results come back query-sharded and are returned as
+    one array."""
+    if params is None:
+        params = cagra_mod.CagraSearchParams(**kwargs)
+    queries = jnp.asarray(queries, jnp.float32)
+    nq = queries.shape[0]
+    n_shards = mesh.shape[axis]
+    expects(nq % n_shards == 0, "n_queries %d not divisible by %d shards", nq, n_shards)
+
+    itopk, width, iters, n_init = cagra_mod.derive_search_config(params, k, index.size)
+    key = as_key(params.seed)
+
+    def local(dataset, sqnorms, graph, q):
+        rank = lax.axis_index(axis)
+        kb = jax.random.fold_in(key, rank)
+        init_ids = jax.random.randint(kb, (q.shape[0], n_init), 0, index.size, jnp.int32)
+        return cagra_mod._cagra_search_impl(
+            dataset, sqnorms, graph, q, init_ids, None,
+            k=k, itopk=itopk, width=width, iters=iters,
+            metric=index.metric, has_filter=False,
+        )
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis)),
+        out_specs=(P(axis), P(axis)),
+        check_vma=False,
+    )
+    put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.jit(fn)(
+        put(index.dataset, P()),
+        put(index.sqnorms, P()),
+        put(index.graph, P()),
+        put(queries, P(axis)),
+    )
+
+
+def sharded_ivf_pq_search(
+    mesh: Mesh,
+    index: "ivf_pq_mod.IvfPqIndex",
+    queries,
+    k: int,
+    params: Optional["ivf_pq_mod.IvfPqSearchParams"] = None,
+    axis: str = "data",
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """IVF-PQ search with queries sharded over the mesh (replicated
+    compressed index). The code footprint is ~pq_dim bytes/row, so a
+    replica per chip covers far larger datasets than raw vectors would;
+    query data-parallelism is the first-order ICI scaling knob."""
+    if params is None:
+        params = ivf_pq_mod.IvfPqSearchParams(**kwargs)
+    queries = jnp.asarray(queries, jnp.float32)
+    nq = queries.shape[0]
+    n_shards = mesh.shape[axis]
+    expects(nq % n_shards == 0, "n_queries %d not divisible by %d shards", nq, n_shards)
+    n_probes = min(params.n_probes, index.n_lists)
+    g = ivf_pq_mod.scan_chunk_lists(index.n_lists, index.max_list)
+    per_cluster = index.codebook_kind == ivf_pq_mod.PER_CLUSTER
+    bf16 = ivf_pq_mod.scan_bf16(params.lut_dtype)
+
+    def local(centers, centers_rot, rotation, pq_centers, codes, li, sqn, q):
+        return ivf_pq_mod._ivf_pq_scan_impl(
+            centers, centers_rot, rotation, pq_centers, codes, li, sqn, q, None,
+            k=k, n_probes=n_probes, metric=index.metric,
+            per_cluster=per_cluster, has_filter=False, chunk_lists=g, bf16=bf16,
+        )
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(), P(), P(axis)),
+        out_specs=(P(axis), P(axis)),
+        check_vma=False,
+    )
+    put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.jit(fn)(
+        put(index.centers, P()),
+        put(index.centers_rot, P()),
+        put(index.rotation, P()),
+        put(index.pq_centers, P()),
+        put(index.codes, P()),
+        put(index.list_indices, P()),
+        put(index.rot_sqnorms, P()),
+        put(queries, P(axis)),
+    )

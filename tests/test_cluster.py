@@ -137,3 +137,34 @@ def test_balanced_fit_predict(blobs):
     centers, labels = kmeans_balanced.fit_predict(X, n_clusters=8, seed=0)
     assert centers.shape == (8, 12)
     assert np.asarray(labels).shape == (1500,)
+
+
+class TestFindK:
+    def test_recovers_planted_k(self, rng):
+        # make_blobs with a planted k; find_k must recover it (the
+        # reference's kmeans_auto_find_k contract)
+        from raft_tpu.cluster.kmeans import find_k
+        from raft_tpu.random import make_blobs
+
+        k_true = 5
+        X, _, _ = make_blobs(3, 600, 8, n_clusters=k_true, cluster_std=0.05)
+        best_k, inertia, n_iter = find_k(np.asarray(X), kmax=10, kmin=2)
+        assert best_k == k_true, best_k
+        assert float(inertia) >= 0
+
+
+class TestMiniBatch:
+    def test_matches_full_fit_quality(self, rng):
+        from raft_tpu.cluster import kmeans
+
+        k = 8
+        c = rng.standard_normal((k, 16)).astype(np.float32) * 4
+        X = (c[rng.integers(0, k, 4000)] + 0.3 * rng.standard_normal((4000, 16))).astype(
+            np.float32
+        )
+        full = kmeans.fit(X, kmeans.KMeansParams(n_clusters=k, seed=0))
+        mb = kmeans.fit_minibatch(
+            X, kmeans.KMeansParams(n_clusters=k, seed=0, batch_samples=512), n_epochs=8
+        )
+        # mini-batch inertia within 20% of full Lloyd on well-separated blobs
+        assert float(mb.inertia) <= 1.2 * float(full.inertia) + 1e-6

@@ -210,3 +210,26 @@ def test_allreduce_prod_shape_and_value(mesh):
 
     out = np.asarray(run_spmd(mesh, body, x, out_specs=P("data")))
     np.testing.assert_array_equal(out, np.full(8, float(np.prod(np.arange(1, 9)))))
+
+
+class TestBootstrap:
+    """Multi-host bootstrap (raft_dask Comms analog) — single-host
+    degenerate path (``raft_dask/common/comms.py:172`` init semantics)."""
+
+    def test_init_single_host_noop(self):
+        from raft_tpu.parallel import bootstrap
+
+        assert bootstrap.init_distributed() is False  # nothing to bootstrap
+
+    def test_global_and_local_mesh(self, mesh):
+        from raft_tpu.parallel import bootstrap
+
+        g = bootstrap.global_mesh()
+        assert g.devices.size == len(jax.devices())
+        l = bootstrap.local_mesh()
+        assert l.devices.size == len(jax.local_devices())
+
+    def test_comms_self_test(self, mesh):
+        from raft_tpu.parallel import bootstrap
+
+        assert bootstrap.run_comms_self_test(mesh) is True

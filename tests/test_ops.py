@@ -281,3 +281,78 @@ def test_min_cluster_cosine_matches_pairwise(rng):
     full = np.asarray(pairwise_distance(x, c, metric=DistanceType.CosineExpanded))
     np.testing.assert_array_equal(np.asarray(labels), np.argmin(full, axis=1))
     np.testing.assert_allclose(np.asarray(dist), full.min(axis=1), rtol=1e-4, atol=1e-4)
+
+
+class TestMaskedNN:
+    """masked_l2_nn parity vs a naive masked reference
+    (``distance/masked_nn.cuh:39`` semantics)."""
+
+    def test_matches_naive(self, rng):
+        m, n, d, ng = 60, 200, 16, 5
+        x = rng.standard_normal((m, d)).astype(np.float32)
+        y = rng.standard_normal((n, d)).astype(np.float32)
+        # contiguous groups with END indices (reference convention)
+        cuts = np.sort(rng.choice(np.arange(1, n), ng - 1, replace=False))
+        group_idxs = np.concatenate([cuts, [n]]).astype(np.int32)
+        adj = rng.random((m, ng)) < 0.5
+        adj[0] = False  # one row with no adjacent group at all
+
+        from raft_tpu.ops.masked_nn import masked_l2_nn
+
+        v, i = masked_l2_nn(x, y, adj, group_idxs)
+        v, i = np.asarray(v), np.asarray(i)
+
+        gid = np.searchsorted(group_idxs, np.arange(n), side="right")
+        d2 = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        for r in range(m):
+            allowed = adj[r][gid]
+            if not allowed.any():
+                assert i[r] == -1 and not np.isfinite(v[r])
+                continue
+            dr = np.where(allowed, d2[r], np.inf)
+            assert i[r] == int(np.argmin(dr))
+            np.testing.assert_allclose(v[r], dr.min(), rtol=1e-4, atol=1e-4)
+
+    def test_sqrt_mode(self, rng):
+        from raft_tpu.ops.masked_nn import masked_l2_nn
+
+        x = rng.standard_normal((10, 8)).astype(np.float32)
+        y = rng.standard_normal((30, 8)).astype(np.float32)
+        adj = np.ones((10, 1), bool)
+        gi = np.array([30], np.int32)
+        v1, i1 = masked_l2_nn(x, y, adj, gi, sqrt=False)
+        v2, i2 = masked_l2_nn(x, y, adj, gi, sqrt=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.sqrt(np.asarray(v1)), np.asarray(v2), rtol=1e-5)
+
+
+class TestKernelGram:
+    """Gram kernels vs naive references (``gram_matrix.cuh:52``,
+    ``kernel_matrices.cuh``)."""
+
+    def test_all_kernels(self, rng):
+        from raft_tpu.ops import kernels as kn
+
+        x = rng.standard_normal((20, 8)).astype(np.float32)
+        y = rng.standard_normal((15, 8)).astype(np.float32)
+        lin = x @ y.T
+        np.testing.assert_allclose(np.asarray(kn.linear_kernel(x, y)), lin, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(kn.polynomial_kernel(x, y, degree=3, gamma=0.5, coef0=1.0)),
+            (0.5 * lin + 1.0) ** 3,
+            rtol=1e-3,
+            atol=1e-4,  # cubing amplifies rounding near zero crossings
+        )
+        np.testing.assert_allclose(
+            np.asarray(kn.tanh_kernel(x, y, gamma=0.2, coef0=0.3)),
+            np.tanh(0.2 * lin + 0.3),
+            rtol=1e-4,
+            atol=1e-6,
+        )
+        d2 = ((x[:, None] - y[None]) ** 2).sum(-1)
+        np.testing.assert_allclose(
+            np.asarray(kn.rbf_kernel(x, y, gamma=0.1)), np.exp(-0.1 * d2), rtol=1e-4
+        )
+        # factory dispatch + symmetric default
+        g = kn.gram_matrix(x, params=kn.KernelParams(kernel=kn.KernelType.RBF, gamma=0.1))
+        assert np.asarray(g).shape == (20, 20)
