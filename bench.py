@@ -1,18 +1,21 @@
-"""Driver-facing smoke benchmark: brute-force kNN QPS on SIFT-shaped data.
+"""Driver-facing benchmark: ANN QPS @ recall@10 on SIFT-1M-shaped data.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-Measures the round-1..N flagship path (exact kNN = pairwise distance +
-select_k, SURVEY.md §7 step 1's "minimum competency test") on synthetic
-SIFT-shaped data (128-d, L2), reporting queries/second at batch size 100 —
-the reference harness's ``items_per_second`` counter
-(``cpp/bench/ann/src/common/benchmark.hpp:330-385``).
+Covers all four index families (brute-force exact + fused-approx,
+IVF-Flat, IVF-PQ (+refine), CAGRA) on synthetic clustered 1M x 128
+float32 — the SIFT-1M shape of BASELINE.md — at batch 1024, reporting
+each algorithm's best QPS at the recall@10 >= 0.95 operating point (the
+reference harness's headline, ``benchmark.hpp:330-385``).
 
-``vs_baseline``: BASELINE.md records no absolute reference QPS (the
-reference publishes only Pareto plots), so we normalize against a fixed
-nominal target of 50k QPS for brute-force SIFT-100k@k=10 — roughly what an
-A100 achieves on this shape with cuBLAS+select_k — making the ratio
-comparable across rounds.
+Headline ``value`` = best QPS@0.95 across algorithms. ``vs_baseline``
+normalizes against 600k QPS — the A100 SIFT-1M IVF-PQ throughput class
+BASELINE.md sets as the north star (the reference publishes no absolute
+tables, so this is a nominal constant kept fixed across rounds).
+
+Everything (data gen, builds, searches) runs on-device; only [nq, k]
+results and scalars cross the host link (which on tethered dev TPUs is
+~2 MB/s — the round-2 bench lost minutes to transfers).
 """
 import json
 import time
@@ -20,60 +23,165 @@ import time
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
-N, D, NQ, K = 100_000, 128, 1000, 10
-BATCH = 100
-NOMINAL_BASELINE_QPS = 50_000.0
+N, D, NQ, K = 1_000_000, 128, 1024, 10
+N_CENTERS = 1000
+CLUSTER_STD = 1.0  # same scale as the center spread: overlapping clusters
+#   (SIFT-like). Tighter blobs make graph traversal between clusters
+#   artificially impossible and every IVF probe artificially perfect.
+NOMINAL_BASELINE_QPS = 600_000.0
+MIN_RECALL = 0.95
+
+
+def _timed(fn, nrep=2, inner=4):
+    """Min wall-clock per call over ``inner`` pipelined calls per sync.
+
+    Dispatches are async; issuing ``inner`` searches before one scalar
+    fetch measures sustained pipelined throughput and amortizes the
+    host-link round trip (~100-300 ms on tunneled dev TPUs — larger than
+    most searches). Sync is a scalar fetch because block_until_ready
+    no-ops through the tunnel."""
+    out = fn()
+    float(jnp.sum(out[0]))  # warm + sync
+    best = float("inf")
+    for _ in range(max(1, nrep)):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = fn()
+        float(jnp.sum(out[0]))
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best, out
 
 
 def main():
-    from raft_tpu.neighbors import brute_force
-    from raft_tpu.ops import DistanceType
+    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+    from raft_tpu.neighbors.refine import refine
+    from raft_tpu.ops.distance import DistanceType
+
+    t_all = time.perf_counter()
+    key = jax.random.PRNGKey(1234)
+    kc, ka, kb, kq1, kq2 = jax.random.split(key, 5)
+    centers = jax.random.normal(kc, (N_CENTERS, D), jnp.float32)
+    dataset = centers[jax.random.randint(ka, (N,), 0, N_CENTERS)] + CLUSTER_STD * jax.random.normal(
+        kb, (N, D), jnp.float32
+    )
+    queries = centers[jax.random.randint(kq1, (NQ,), 0, N_CENTERS)] + CLUSTER_STD * jax.random.normal(
+        kq2, (NQ, D), jnp.float32
+    )
+    float(jnp.sum(dataset[0]))
+
+    # ground truth + exact brute-force timing
+    bf = brute_force.build(dataset, metric=DistanceType.L2Expanded)
+    t_exact, (ev, ei) = _timed(
+        lambda: brute_force.search(bf, queries, K, query_batch=NQ, dataset_tile=262144),
+        nrep=2,
+    )
+    gt = np.asarray(ei)
+
     from raft_tpu.stats import neighborhood_recall
 
-    rng = np.random.default_rng(42)
-    dataset = rng.standard_normal((N, D), dtype=np.float32)
-    queries = rng.standard_normal((NQ, D), dtype=np.float32)
+    def recall(i):
+        return float(neighborhood_recall(np.asarray(i)[:, :K], gt))
 
-    index = brute_force.build(dataset, metric=DistanceType.L2Expanded)
-    jax.block_until_ready(index.dataset)
+    results = {}  # algo -> list of (config, qps, recall)
 
-    # Warmup (compile)
-    d, i = brute_force.search(index, queries[:BATCH], K, query_batch=BATCH)
-    jax.block_until_ready((d, i))
+    def record(algo, config, dt, idx):
+        results.setdefault(algo, []).append(
+            {"config": config, "qps": round(NQ / dt, 1), "recall": round(recall(idx), 4)}
+        )
+        print(f"# {algo:16s} {config:34s} {NQ/dt:>12,.0f} qps  recall={results[algo][-1]['recall']:.4f}",
+              flush=True)
 
-    # Timed: sweep all queries in batches
+    build_times = {"brute_force": 0.0}
+    record("brute_force_exact", "tile=262144", t_exact, ei)
+
+    dt, (v, i) = _timed(lambda: brute_force.search(bf, queries, K, mode="approx"))
+    record("brute_force", "approx rt=0.99", dt, i)
+
     t0 = time.perf_counter()
-    outs = []
-    for s in range(0, NQ, BATCH):
-        outs.append(brute_force.search(index, queries[s : s + BATCH], K, query_batch=BATCH))
-    jax.block_until_ready(outs)
-    dt = time.perf_counter() - t0
-    qps = NQ / dt
+    fidx = ivf_flat.build(
+        dataset,
+        ivf_flat.IvfFlatIndexParams(n_lists=1024, kmeans_n_iters=10, kmeans_trainset_fraction=0.1),
+    )
+    float(jnp.sum(fidx.list_sizes))
+    build_times["ivf_flat"] = round(time.perf_counter() - t0, 1)
+    for npr in (10, 20, 50):
+        dt, (v, i) = _timed(lambda npr=npr: ivf_flat.search(fidx, queries, K, n_probes=npr))
+        record("ivf_flat", f"nprobe={npr}", dt, i)
 
-    # Sampled recall sanity vs exact numpy on a small subset.
-    sub = 50
-    d2 = ((queries[:sub, None, :] - dataset[None, :2000, :]) ** 2).sum(-1)
-    ref_idx = np.argsort(d2, axis=1)[:, :K]
-    sub_idx = np.asarray(brute_force.search(
-        brute_force.build(dataset[:2000], metric=DistanceType.L2Expanded),
-        queries[:sub], K)[1])
-    recall = float(neighborhood_recall(sub_idx, ref_idx))
+    t0 = time.perf_counter()
+    pidx = ivf_pq.build(
+        dataset,
+        ivf_pq.IvfPqIndexParams(n_lists=1024, pq_dim=64, kmeans_n_iters=10, kmeans_trainset_fraction=0.1),
+    )
+    float(jnp.sum(pidx.list_sizes))
+    build_times["ivf_pq"] = round(time.perf_counter() - t0, 1)
+    sp = ivf_pq.IvfPqSearchParams(n_probes=50, lut_dtype=jnp.bfloat16)
+    dt, (v, i) = _timed(lambda: ivf_pq.search(pidx, queries, K, sp), nrep=2)
+    record("ivf_pq", "nprobe=50 bf16", dt, i)
+
+    def pq_refined():
+        _, cand = ivf_pq.search(pidx, queries, 4 * K, sp)
+        return refine(dataset, queries, cand, K, metric=DistanceType.L2Expanded)
+
+    dt, (v, i) = _timed(pq_refined, nrep=2)
+    record("ivf_pq", "nprobe=50 bf16 refine=4x", dt, i)
+
+    cagra_err = None
+    try:
+        t0 = time.perf_counter()
+        cidx = cagra.build(
+            dataset,
+            cagra.CagraIndexParams(
+                intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8
+            ),
+        )
+        float(jnp.sum(cidx.graph[0].astype(jnp.float32)))
+        build_times["cagra"] = round(time.perf_counter() - t0, 1)
+        for itopk, w in ((64, 2), (128, 4)):
+            dt, (v, i) = _timed(
+                lambda itopk=itopk, w=w: cagra.search(
+                    cidx, queries, K, cagra.CagraSearchParams(itopk_size=itopk, search_width=w)
+                ),
+                nrep=2,
+            )
+            record("cagra", f"itopk={itopk} width={w}", dt, i)
+    except Exception as e:  # noqa: BLE001 — a single-algo failure must not kill the bench
+        cagra_err = f"{type(e).__name__}: {e}"[:200]
+        print(f"# cagra skipped: {cagra_err}", flush=True)
+
+    # operating points: best QPS at recall >= MIN_RECALL per algorithm
+    ops = {}
+    for algo, rows in results.items():
+        ok = [r for r in rows if r["recall"] >= MIN_RECALL]
+        ops[algo] = max(ok, key=lambda r: r["qps"]) if ok else None
+    reached = {a: r for a, r in ops.items() if r is not None}
+    best_algo, best = max(reached.items(), key=lambda kv: kv[1]["qps"])
 
     print(
         json.dumps(
             {
-                "metric": "bf_knn_qps_sift100k_k10_b100",
-                "value": round(qps, 2),
+                "metric": "ann_best_qps_at_recall95_sift1m_synth_b1024_k10",
+                "value": best["qps"],
                 "unit": "qps",
-                "vs_baseline": round(qps / NOMINAL_BASELINE_QPS, 4),
+                "vs_baseline": round(best["qps"] / NOMINAL_BASELINE_QPS, 4),
                 "extra": {
+                    "best_algo": best_algo,
+                    "best_config": best["config"],
+                    "best_recall": best["recall"],
+                    "operating_points_at_0.95": {
+                        a: (r if r else "not reached") for a, r in ops.items()
+                    },
+                    "all_results": results,
+                    "build_seconds": build_times,
+                    "cagra_error": cagra_err,
                     "n": N,
-                    "d": D,
+                    "dim": D,
+                    "n_queries": NQ,
                     "k": K,
-                    "batch": BATCH,
-                    "recall_sampled": round(recall, 4),
-                    "device": str(jax.devices()[0].platform),
+                    "device": str(jax.devices()[0]),
+                    "total_bench_seconds": round(time.perf_counter() - t_all, 1),
                 },
             }
         )

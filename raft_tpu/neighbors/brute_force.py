@@ -358,3 +358,57 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> BruteForceIndex:
     dataset = ser.deserialize_array(stream)
     norms = ser.deserialize_array(stream) if has_norms else None
     return BruteForceIndex(dataset=dataset, norms=norms, metric=metric, metric_arg=metric_arg)
+
+
+class BatchKQuery:
+    """Lazy batched-k query iterator — analog of
+    ``neighbors/detail/knn_brute_force_batch_k_query.cuh`` /
+    ``neighbors/brute_force-inl.cuh`` ``batch_k_query``: page through a
+    query's neighbors ``batch_size`` at a time, searching lazily with a
+    growing k (and over-fetching ahead like the reference's 1.5x growth)
+    so cheap "first page" consumers never pay for deep ks.
+
+    >>> for batch in BatchKQuery(index, queries, batch_size=32):
+    ...     ids, dists = batch.indices, batch.distances   # [nq, 32] each
+    """
+
+    class Batch:
+        def __init__(self, distances, indices, offset):
+            self.distances = distances
+            self.indices = indices
+            self.offset = offset
+
+    def __init__(self, index: BruteForceIndex, queries, batch_size: int, mode: str = "exact"):
+        expects(batch_size >= 1, "batch_size must be >= 1")
+        self.index = index
+        self.queries = jnp.asarray(queries)
+        self.batch_size = int(batch_size)
+        self.mode = mode
+        self._k = 0  # neighbors fetched so far
+        self._dists = None
+        self._ids = None
+
+    def _ensure(self, k: int) -> None:
+        if k <= self._k:
+            return
+        # over-fetch 1.5x ahead (the reference grows the same way) but
+        # never past the index size
+        k_fetch = min(self.index.size, max(k, int(1.5 * k)))
+        self._dists, self._ids = search(
+            self.index, self.queries, k_fetch, mode=self.mode
+        )
+        self._k = k_fetch
+
+    def batch(self, i: int) -> "BatchKQuery.Batch":
+        """The i-th page of neighbors: ranks [i*bs, (i+1)*bs)."""
+        lo = i * self.batch_size
+        hi = min(lo + self.batch_size, self.index.size)
+        expects(lo < self.index.size, "batch %d past index size", i)
+        self._ensure(hi)
+        return BatchKQuery.Batch(self._dists[:, lo:hi], self._ids[:, lo:hi], lo)
+
+    def __iter__(self):
+        i = 0
+        while i * self.batch_size < self.index.size:
+            yield self.batch(i)
+            i += 1

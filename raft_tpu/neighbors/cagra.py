@@ -84,27 +84,88 @@ class CagraSearchParams:
     seed: int = 0
 
 
+@dataclasses.dataclass
+class VpqParams:
+    """``vpq_params`` analog (``neighbors/dataset.hpp:210-235``): coarse
+    vector quantization + product quantization of the residual."""
+
+    vq_n_centers: int = 0  # 0 = auto (~sqrt(n))
+    pq_dim: int = 0  # 0 = auto (dim / 4, min 1)
+    pq_bits: int = 8
+    kmeans_n_iters: int = 15
+    seed: int = 0
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
-class CagraIndex:
-    """Fixed-degree graph + dataset (``cagra_types.hpp:142``)."""
+class VpqDataset:
+    """VQ+PQ compressed dataset (``vpq_dataset``,
+    ``neighbors/dataset.hpp:236-259``): each row is a coarse VQ center
+    plus PQ-coded residual, ~pq_dim bytes/row instead of 4*dim — the
+    beyond-HBM story for large CAGRA datasets. Decoding during beam
+    search is a one-hot MXU matmul (TPUs have no fast per-lane gather)."""
 
-    dataset: jax.Array  # [n, d]
-    sqnorms: jax.Array  # [n] f32 (L2 metrics)
-    graph: jax.Array  # [n, graph_degree] i32
-    metric: DistanceType
-    size: int
+    vq_centers: jax.Array  # [vq_n, d] f32
+    vq_labels: jax.Array  # [n] i32
+    pq_centers: jax.Array  # [pq_dim, ksub, pq_len] f32
+    codes: jax.Array  # [n, pq_dim] u8
+    sqnorms: jax.Array  # [n] f32 — ||decoded row||^2, precomputed
 
     def tree_flatten(self):
-        return (self.dataset, self.sqnorms, self.graph), (self.metric, self.size)
+        return (self.vq_centers, self.vq_labels, self.pq_centers, self.codes, self.sqnorms), ()
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, metric=aux[0], size=aux[1])
+        return cls(*children)
+
+    @property
+    def pq_dim(self) -> int:
+        return self.codes.shape[1]
+
+    @property
+    def ksub(self) -> int:
+        return self.pq_centers.shape[1]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class CagraIndex:
+    """Fixed-degree graph + dataset (``cagra_types.hpp:142``). The dataset
+    is either raw rows or a :class:`VpqDataset` (``neighbors/dataset.hpp:37``
+    strided vs ``:259`` vpq dataset variants)."""
+
+    dataset: Optional[jax.Array]  # [n, d], or None when vpq is set
+    sqnorms: Optional[jax.Array]  # [n] f32 (L2 metrics)
+    graph: jax.Array  # [n, graph_degree] i32
+    metric: DistanceType
+    size: int
+    vpq: Optional[VpqDataset] = None
+    dim_hint: int = 0  # feature dim when dataset is compressed away
+
+    def tree_flatten(self):
+        return (self.dataset, self.sqnorms, self.graph, self.vpq), (
+            self.metric,
+            self.size,
+            self.dim_hint,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(
+            dataset=children[0],
+            sqnorms=children[1],
+            graph=children[2],
+            vpq=children[3],
+            metric=aux[0],
+            size=aux[1],
+            dim_hint=aux[2],
+        )
 
     @property
     def dim(self) -> int:
-        return self.dataset.shape[1]
+        if self.dataset is not None:
+            return self.dataset.shape[1]
+        return self.dim_hint or self.vpq.vq_centers.shape[1]
 
     @property
     def graph_degree(self) -> int:
@@ -289,7 +350,7 @@ def from_graph(dataset, graph, metric=DistanceType.L2Expanded) -> CagraIndex:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "itopk", "width", "iters", "metric", "has_filter"),
+    static_argnames=("k", "itopk", "width", "iters", "metric", "has_filter", "use_vpq"),
 )
 def _cagra_search_impl(
     dataset,
@@ -298,6 +359,7 @@ def _cagra_search_impl(
     queries,
     init_ids,
     filter_bits,
+    vpq_arrays=None,  # (vq_centers, vq_labels, pq_centers, codes) or None
     *,
     k: int,
     itopk: int,
@@ -305,6 +367,7 @@ def _cagra_search_impl(
     iters: int,
     metric: DistanceType,
     has_filter: bool,
+    use_vpq: bool = False,
 ):
     nq, d = queries.shape
     n, deg = graph.shape
@@ -313,9 +376,28 @@ def _cagra_search_impl(
     worst = jnp.asarray(worst_value(jnp.float32, select_min), jnp.float32)
     q_sqnorm = jnp.sum(qf * qf, axis=1)
 
+    def gather_vecs(safe):
+        if not use_vpq:
+            return dataset[safe].astype(jnp.float32)  # [nq, c, d]
+        # VPQ decode (dataset.hpp:259 vpq_dataset): coarse VQ center +
+        # one-hot-matmul PQ residual — the TPU substitute for the CUDA
+        # per-lane LUT gather
+        vq_centers, vq_labels, pq_centers, codes = vpq_arrays
+        ksub = pq_centers.shape[1]
+        c = safe.shape[1]
+        base = vq_centers[vq_labels[safe]]  # [nq, c, d]
+        cod = codes[safe].astype(jnp.int32)  # [nq, c, pq_dim]
+        onehot = (
+            cod[..., None] == jnp.arange(ksub, dtype=jnp.int32)
+        ).astype(jnp.float32)
+        resid = jnp.einsum(
+            "qcjs,jst->qcjt", onehot, pq_centers, preferred_element_type=jnp.float32
+        )
+        return base + resid.reshape(nq, c, d)
+
     def score(cand):  # cand: [nq, c] ids, -1 invalid
         safe = jnp.clip(cand, 0, None)
-        vecs = dataset[safe].astype(jnp.float32)  # [nq, c, d]
+        vecs = gather_vecs(safe)
         # HIGHEST: single-pass bf16 MXU rounding visibly degrades beam
         # ranking (measured ~6 recall points on TPU); these matmuls are tiny
         # and HBM-bound, so full-precision passes cost ~nothing.
@@ -427,19 +509,33 @@ def search(
             qc = jnp.pad(qc, ((0, bpad), (0, 0)))
         key, kb = jax.random.split(key)
         init_ids = jax.random.randint(kb, (qc.shape[0], n_init), 0, index.size, jnp.int32)
+        use_vpq = index.dataset is None
+        vpq_arrays = None
+        sqnorms = index.sqnorms
+        if use_vpq:
+            expects(index.vpq is not None, "index has neither dataset nor vpq data")
+            vpq_arrays = (
+                index.vpq.vq_centers,
+                index.vpq.vq_labels,
+                index.vpq.pq_centers,
+                index.vpq.codes,
+            )
+            sqnorms = index.vpq.sqnorms
         v, i = _cagra_search_impl(
             index.dataset,
-            index.sqnorms,
+            sqnorms,
             index.graph,
             qc,
             init_ids,
             filter_bits,
+            vpq_arrays,
             k=k,
             itopk=itopk,
             width=width,
             iters=iters,
             metric=index.metric,
             has_filter=filter_bits is not None,
+            use_vpq=use_vpq,
         )
         if bpad:
             v, i = v[:-bpad], i[:-bpad]
@@ -451,21 +547,118 @@ def search(
 
 
 # ---------------------------------------------------------------------------
+# VPQ compression (neighbors/dataset.hpp:210-259 vpq_dataset)
+# ---------------------------------------------------------------------------
+
+
+def _default_vpq_pq_dim(d: int) -> int:
+    for cand in (d // 4, d // 2, d):
+        if cand >= 1 and d % cand == 0:
+            return cand
+    return d
+
+
+def compress(index: CagraIndex, params: Optional[VpqParams] = None, **kwargs) -> CagraIndex:
+    """Replace the raw dataset with a VQ+PQ compressed one
+    (``cagra::compress`` / ``vpq_build``, ``neighbors/dataset.hpp:210``):
+    coarse VQ centers + per-subspace PQ codebooks over the VQ residuals.
+    Search decodes candidates on the fly; memory drops from ``4*dim``
+    to ``~pq_dim`` bytes per row."""
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.cluster.kmeans_balanced import BalancedKMeansParams
+    from raft_tpu.neighbors.ivf_pq import _batched_lloyd
+    from raft_tpu.ops.fused_1nn import min_cluster_and_distance
+
+    if params is None:
+        params = VpqParams(**kwargs)
+    expects(index.dataset is not None, "index already compressed")
+    ds = index.dataset.astype(jnp.float32)
+    n, d = ds.shape
+    vq_n = params.vq_n_centers or max(8, min(1024, int(round(n ** 0.5))))
+    pq_dim = params.pq_dim or _default_vpq_pq_dim(d)
+    expects(d % pq_dim == 0, "dim %d must be divisible by pq_dim %d", d, pq_dim)
+    pq_len = d // pq_dim
+    ksub = 1 << params.pq_bits
+
+    key, k_sub, k_init = jax.random.split(as_key(params.seed), 3)
+    vq_centers = kmeans_balanced.fit(
+        ds,
+        BalancedKMeansParams(
+            n_clusters=vq_n, n_iters=params.kmeans_n_iters, seed=params.seed
+        ),
+    )
+    vq_labels, _ = min_cluster_and_distance(ds, vq_centers)
+    resid = (ds - vq_centers[vq_labels]).reshape(n, pq_dim, pq_len)
+
+    # per-subspace codebooks on (a subsample of) the residuals
+    nt = min(n, ksub * 256)
+    sub = jax.random.permutation(k_sub, n)[:nt]
+    Xs = jnp.transpose(resid[sub], (1, 0, 2))  # [pq_dim, nt, pq_len]
+    init_idx = jax.random.permutation(k_init, nt)[: min(ksub, nt)]
+    init = Xs[:, init_idx, :]
+    if init.shape[1] < ksub:
+        reps = -(-ksub // init.shape[1])
+        init = jnp.tile(init, (1, reps, 1))[:, :ksub, :]
+    pq_centers = _batched_lloyd(
+        Xs, jnp.ones((pq_dim, nt), jnp.float32), init, k=ksub, n_iters=params.kmeans_n_iters
+    )
+
+    # encode: nearest sub-center per subspace (chunked)
+    cn = jnp.sum(pq_centers * pq_centers, axis=-1)  # [pq_dim, ksub]
+    codes_parts = []
+    sq_parts = []
+    chunk = 131072
+    for s in range(0, n, chunk):
+        rr = resid[s : s + chunk]  # [c, pq_dim, pq_len]
+        dots = jnp.einsum("cjl,jkl->cjk", rr, pq_centers, preferred_element_type=jnp.float32)
+        code = jnp.argmax(2.0 * dots - cn[None, :, :], axis=-1).astype(jnp.uint8)
+        codes_parts.append(code)
+        # decoded sqnorm for the score epilogue
+        dec = jnp.take_along_axis(
+            pq_centers[None], code[:, :, None, None].astype(jnp.int32), axis=2
+        )[:, :, 0, :].reshape(-1, d) + vq_centers[vq_labels[s : s + chunk]]
+        sq_parts.append(jnp.sum(dec * dec, axis=1))
+    codes = codes_parts[0] if len(codes_parts) == 1 else jnp.concatenate(codes_parts)
+    sqnorms = sq_parts[0] if len(sq_parts) == 1 else jnp.concatenate(sq_parts)
+
+    vpq = VpqDataset(
+        vq_centers=vq_centers,
+        vq_labels=vq_labels.astype(jnp.int32),
+        pq_centers=pq_centers,
+        codes=codes,
+        sqnorms=sqnorms,
+    )
+    return dataclasses.replace(
+        index, dataset=None, sqnorms=None, vpq=vpq, dim_hint=d
+    )
+
+
+# ---------------------------------------------------------------------------
 # serialization (neighbors/cagra_serialize.cuh analog)
 # ---------------------------------------------------------------------------
 
 _KIND = "cagra"
-_VERSION = 1
+_VERSION = 2
 
 
 def save(index: CagraIndex, stream: BinaryIO, include_dataset: bool = True) -> None:
     ser.dump_header(stream, _KIND, _VERSION)
     ser.serialize_scalar(stream, int(index.metric), "int32")
     ser.serialize_scalar(stream, int(index.size), "int64")
-    ser.serialize_scalar(stream, int(include_dataset), "int32")
+    has_raw = index.dataset is not None and include_dataset
+    has_vpq = index.vpq is not None
+    ser.serialize_scalar(stream, int(has_raw), "int32")
+    ser.serialize_scalar(stream, int(has_vpq), "int32")
+    ser.serialize_scalar(stream, int(index.dim), "int32")
     ser.serialize_array(stream, index.graph)
-    if include_dataset:
+    if has_raw:
         ser.serialize_array(stream, index.dataset)
+    if has_vpq:
+        ser.serialize_array(stream, index.vpq.vq_centers)
+        ser.serialize_array(stream, index.vpq.vq_labels)
+        ser.serialize_array(stream, index.vpq.pq_centers)
+        ser.serialize_array(stream, index.vpq.codes)
+        ser.serialize_array(stream, index.vpq.sqnorms)
 
 
 def load(stream: BinaryIO, dataset=None, res: Optional[Resources] = None) -> CagraIndex:
@@ -473,15 +666,32 @@ def load(stream: BinaryIO, dataset=None, res: Optional[Resources] = None) -> Cag
     supplied (mirrors the reference's dataset-less serialize mode,
     ``cagra_serialize.cuh``)."""
     ensure_resources(res)
-    ser.check_header(stream, _KIND)
+    version = ser.check_header(stream, _KIND)
     metric = DistanceType(ser.deserialize_scalar(stream, "int32"))
     size = int(ser.deserialize_scalar(stream, "int64"))
     has_ds = bool(ser.deserialize_scalar(stream, "int32"))
+    has_vpq = bool(ser.deserialize_scalar(stream, "int32")) if version >= 2 else False
+    dim = int(ser.deserialize_scalar(stream, "int32")) if version >= 2 else 0
     graph = ser.deserialize_array(stream)
+    vpq = None
     if has_ds:
         data = ser.deserialize_array(stream)
-    else:
+    if has_vpq:
+        vpq = VpqDataset(
+            vq_centers=ser.deserialize_array(stream),
+            vq_labels=ser.deserialize_array(stream),
+            pq_centers=ser.deserialize_array(stream),
+            codes=ser.deserialize_array(stream),
+            sqnorms=ser.deserialize_array(stream),
+        )
+    if not has_ds:
+        if vpq is not None and dataset is None:
+            return CagraIndex(
+                dataset=None, sqnorms=None, graph=graph, metric=metric,
+                size=size, vpq=vpq, dim_hint=dim,
+            )
         expects(dataset is not None, "index was saved without dataset; pass one")
         data = jnp.asarray(dataset)
     expects(data.shape[0] == size, "dataset rows != index size")
-    return from_graph(data, graph, metric)
+    out = from_graph(data, graph, metric)
+    return dataclasses.replace(out, vpq=vpq, dim_hint=dim)

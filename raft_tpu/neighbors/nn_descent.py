@@ -181,10 +181,10 @@ def build(
     worst = jnp.asarray(worst_value(jnp.float32, select_min), jnp.float32)
     chunk = max(256, params.node_chunk)
 
-    def merge_candidates(acc_v, acc_i, acc_f, cand_ids):
+    def merge_candidates(acc_v, acc_i, acc_f, cand_of_chunk):
         out_v, out_i, out_f = [], [], []
         for s in range(0, n, chunk):
-            c = cand_ids[s : s + chunk]
+            c = cand_of_chunk(s)
             v, i, f = _score_and_merge(
                 data, sqnorms, c,
                 acc_v[s : s + chunk], acc_i[s : s + chunk], acc_f[s : s + chunk],
@@ -202,22 +202,32 @@ def build(
     acc_v = jnp.full((n, k), worst, jnp.float32)
     acc_i = jnp.full((n, k), -1, jnp.int32)
     sampled = jnp.zeros((n, k), bool)  # everything new (never sampled)
-    acc_v, acc_i, sampled = merge_candidates(acc_v, acc_i, sampled, init_ids)
+    acc_v, acc_i, sampled = merge_candidates(
+        acc_v, acc_i, sampled, lambda s: init_ids[s : s + chunk]
+    )
 
     half = max(1, min(params.max_samples // 2, k))
+
+    @functools.partial(jax.jit, static_argnames=())
+    def _two_hop_chunk(sym, sym_c):
+        # candidates(u) = P(P(u)) for one row chunk — expanding per chunk
+        # keeps the [chunk, 4h, 4h] gather small (the full [n, 4h, 4h]
+        # tensor is 4*n*half^2 ints and blows HBM at 1M rows)
+        safe_c = jnp.clip(sym_c, 0, None)
+        cand = jnp.where(sym_c[:, :, None] >= 0, sym[safe_c], -1)
+        cand = cand.reshape(sym_c.shape[0], -1)
+        return jnp.concatenate([cand, sym_c], axis=1)  # include one-hop too
+
     for it in range(params.max_iterations):
         key, k_sample = jax.random.split(key)
         pool, sampled = _sample_pool(k_sample, acc_i, sampled, half=half)
         rev = reverse_edges(pool, n, 2 * half)
         sym = jnp.concatenate([pool, rev], axis=1)  # [n, 4*half]
 
-        # two-hop expansion: candidates(u) = P(P(u))
-        safe = jnp.clip(sym, 0, None)
-        cand = jnp.where(sym[:, :, None] >= 0, sym[safe], -1).reshape(n, -1)
-        cand = jnp.concatenate([cand, sym], axis=1)  # include one-hop too
-
         prev_i = acc_i
-        acc_v, acc_i, sampled = merge_candidates(acc_v, acc_i, sampled, cand)
+        acc_v, acc_i, sampled = merge_candidates(
+            acc_v, acc_i, sampled, lambda s: _two_hop_chunk(sym, sym[s : s + chunk])
+        )
 
         # update rate = fraction of entries not present before (sorted lookup)
         prev_sorted = jnp.sort(prev_i, axis=1)

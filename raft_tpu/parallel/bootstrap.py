@@ -25,7 +25,6 @@ Pod usage (one process per host)::
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Optional, Sequence
 
 import jax
@@ -36,16 +35,6 @@ from raft_tpu.core.logging import info, warn
 from raft_tpu.parallel import comms as comms_mod
 
 _initialized = False
-
-
-@dataclasses.dataclass
-class DistributedConfig:
-    """Arguments forwarded to ``jax.distributed.initialize`` — the
-    uniqueId/rank/nranks triple of ``nccl.pyx:89`` in TPU form."""
-
-    coordinator_address: Optional[str] = None
-    num_processes: Optional[int] = None
-    process_id: Optional[int] = None
 
 
 def init_distributed(

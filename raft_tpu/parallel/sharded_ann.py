@@ -29,11 +29,49 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import functools
+
 from raft_tpu.core.errors import expects
 from raft_tpu.neighbors import cagra as cagra_mod, ivf_flat as ivf_flat_mod, ivf_pq as ivf_pq_mod
 from raft_tpu.ops.distance import DistanceType
 from raft_tpu.ops.select_k import merge_parts
 from raft_tpu.random.rng import as_key
+
+
+@functools.lru_cache(maxsize=64)
+def _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local):
+    """Cached jitted shard_map program (rebuilding it per call would
+    re-trace and recompile every search)."""
+
+    def local(centers, ld, li, ln, q):
+        rank = lax.axis_index(axis)
+        qf = q
+        if metric == DistanceType.CosineExpanded:
+            qf = qf / jnp.maximum(jnp.linalg.norm(qf, axis=1, keepdims=True), 1e-12)
+        probed = ivf_flat_mod.probe_mask(centers, qf, n_probes, metric)
+        probed_local = lax.dynamic_slice_in_dim(probed, rank * l_local, l_local, axis=1)
+        v, i = ivf_flat_mod.flat_scan_core(
+            ld, li, ln, qf, probed_local, None,
+            k=k, metric=metric, has_filter=False, chunk_lists=g,
+        )
+        all_v = jax.lax.all_gather(v, axis)
+        all_i = jax.lax.all_gather(i, axis)
+        nq = q.shape[0]
+        cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
+        cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
+        select_min = metric != DistanceType.InnerProduct
+        # invalid (-1) slots carry +/-inf values and lose the merge
+        return merge_parts(cat_v, cat_i, k, select_min=select_min)
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
 
 
 def sharded_ivf_flat_search(
@@ -61,43 +99,47 @@ def sharded_ivf_flat_search(
     metric = index.metric
     g = ivf_flat_mod.scan_chunk_lists(l_local, index.max_list)
 
-    def local(centers, ld, li, ln, q):
-        rank = lax.axis_index(axis)
-        qf = q
-        if metric == DistanceType.CosineExpanded:
-            qf = qf / jnp.maximum(jnp.linalg.norm(qf, axis=1, keepdims=True), 1e-12)
-        probed = ivf_flat_mod.probe_mask(centers, qf, n_probes, metric)
-        probed_local = lax.dynamic_slice_in_dim(probed, rank * l_local, l_local, axis=1)
-        v, i = ivf_flat_mod.flat_scan_core(
-            ld, li, ln, qf, probed_local, None,
-            k=k, metric=metric, has_filter=False, chunk_lists=g,
-        )
-        all_v = jax.lax.all_gather(v, axis)
-        all_i = jax.lax.all_gather(i, axis)
-        nq = q.shape[0]
-        cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
-        cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
-        select_min = metric != DistanceType.InnerProduct
-        # invalid (-1) slots carry +/-inf values and lose the merge
-        return merge_parts(cat_v, cat_i, k, select_min=select_min)
-
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
+    fn = _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local)
     ln = index.list_norms
     if ln is None:
         ln = jnp.zeros(index.list_indices.shape, jnp.float32)
     put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
-    return jax.jit(fn)(
+    return fn(
         put(index.centers, P()),
         put(index.list_data, P(axis)),
         put(index.list_indices, P(axis)),
         put(ln, P(axis)),
         put(queries, P()),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _cagra_fn(mesh, axis, k, itopk, width, iters, n_init, size, metric, seed, use_vpq):
+    key = as_key(seed)
+
+    def local(sqnorms, graph, q, *data_args):
+        rank = lax.axis_index(axis)
+        kb = jax.random.fold_in(key, rank)
+        init_ids = jax.random.randint(kb, (q.shape[0], n_init), 0, size, jnp.int32)
+        if use_vpq:
+            dataset, vpq_arrays = None, tuple(data_args)
+        else:
+            (dataset,), vpq_arrays = data_args, None
+        return cagra_mod._cagra_search_impl(
+            dataset, sqnorms, graph, q, init_ids, None, vpq_arrays,
+            k=k, itopk=itopk, width=width, iters=iters,
+            metric=metric, has_filter=False, use_vpq=use_vpq,
+        )
+
+    n_data = 4 if use_vpq else 1
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(axis)) + (P(),) * n_data,
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )
     )
 
 
@@ -121,31 +163,49 @@ def sharded_cagra_search(
     expects(nq % n_shards == 0, "n_queries %d not divisible by %d shards", nq, n_shards)
 
     itopk, width, iters, n_init = cagra_mod.derive_search_config(params, k, index.size)
-    key = as_key(params.seed)
-
-    def local(dataset, sqnorms, graph, q):
-        rank = lax.axis_index(axis)
-        kb = jax.random.fold_in(key, rank)
-        init_ids = jax.random.randint(kb, (q.shape[0], n_init), 0, index.size, jnp.int32)
-        return cagra_mod._cagra_search_impl(
-            dataset, sqnorms, graph, q, init_ids, None,
-            k=k, itopk=itopk, width=width, iters=iters,
-            metric=index.metric, has_filter=False,
-        )
-
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(), P(), P(), P(axis)),
-        out_specs=(P(axis), P(axis)),
-        check_vma=False,
+    use_vpq = index.dataset is None
+    if use_vpq:
+        expects(index.vpq is not None, "index has neither dataset nor vpq data")
+    fn = _cagra_fn(
+        mesh, axis, k, itopk, width, iters, n_init, index.size, index.metric,
+        params.seed, use_vpq,
     )
     put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
-    return jax.jit(fn)(
-        put(index.dataset, P()),
+    if use_vpq:
+        return fn(
+            put(index.vpq.sqnorms, P()),
+            put(index.graph, P()),
+            put(queries, P(axis)),
+            put(index.vpq.vq_centers, P()),
+            put(index.vpq.vq_labels, P()),
+            put(index.vpq.pq_centers, P()),
+            put(index.vpq.codes, P()),
+        )
+    return fn(
         put(index.sqnorms, P()),
         put(index.graph, P()),
         put(queries, P(axis)),
+        put(index.dataset, P()),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _ivf_pq_fn(mesh, axis, k, n_probes, metric, per_cluster, g, bf16):
+    def local(centers, centers_rot, rotation, pq_centers, codes, li, sqn, q):
+        return ivf_pq_mod._ivf_pq_scan_impl(
+            centers, centers_rot, rotation, pq_centers, codes, li, sqn, q, None,
+            k=k, n_probes=n_probes, metric=metric,
+            per_cluster=per_cluster, has_filter=False, chunk_lists=g, bf16=bf16,
+        )
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(axis)),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )
     )
 
 
@@ -173,22 +233,9 @@ def sharded_ivf_pq_search(
     per_cluster = index.codebook_kind == ivf_pq_mod.PER_CLUSTER
     bf16 = ivf_pq_mod.scan_bf16(params.lut_dtype)
 
-    def local(centers, centers_rot, rotation, pq_centers, codes, li, sqn, q):
-        return ivf_pq_mod._ivf_pq_scan_impl(
-            centers, centers_rot, rotation, pq_centers, codes, li, sqn, q, None,
-            k=k, n_probes=n_probes, metric=index.metric,
-            per_cluster=per_cluster, has_filter=False, chunk_lists=g, bf16=bf16,
-        )
-
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(), P(), P(), P(axis)),
-        out_specs=(P(axis), P(axis)),
-        check_vma=False,
-    )
+    fn = _ivf_pq_fn(mesh, axis, k, n_probes, index.metric, per_cluster, g, bf16)
     put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
-    return jax.jit(fn)(
+    return fn(
         put(index.centers, P()),
         put(index.centers_rot, P()),
         put(index.rotation, P()),

@@ -4,6 +4,7 @@ make_blobs data gen, R-MAT graph gen.
 See ``SURVEY.md`` §2.3 (``/root/reference/cpp/include/raft/random``).
 """
 from raft_tpu.random.make_blobs import make_blobs
+from raft_tpu.random.make_regression import make_regression, multi_variable_gaussian
 from raft_tpu.random.rmat import rmat
 from raft_tpu.random.rng import (
     as_key,
@@ -22,6 +23,8 @@ from raft_tpu.random.rng import (
 
 __all__ = [
     "make_blobs",
+    "make_regression",
+    "multi_variable_gaussian",
     "rmat",
     "as_key",
     "bernoulli",

@@ -1,0 +1,101 @@
+"""Regression data generator — analog of ``raft::random::make_regression``
+(``random/make_regression.cuh:38-99``; GPU equivalent of
+sklearn.datasets.make_regression) and ``multi_variable_gaussian``
+(``random/multi_variable_gaussian.cuh``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.errors import expects
+from raft_tpu.random.rng import KeyLike, as_key
+
+
+def make_regression(
+    key: KeyLike,
+    n_samples: int,
+    n_features: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    effective_rank: Optional[int] = None,
+    tail_strength: float = 0.5,
+    noise: float = 0.0,
+    shuffle: bool = True,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Random linear-regression problem; returns ``(X [n, p], y [n, t],
+    coef [p, t])`` with y = X @ coef + bias + N(0, noise).
+
+    Mirrors ``make_regression`` (``random/make_regression.cuh:73``):
+    ``n_informative`` features carry non-zero coefficients; with
+    ``effective_rank`` set, X is built low-rank with a ``tail_strength``
+    fat singular-value tail (the reference's singular-profile path).
+    """
+    n_informative = n_features if n_informative is None else min(n_informative, n_features)
+    expects(n_samples >= 1 and n_features >= 1 and n_targets >= 1, "bad shapes")
+    key = as_key(key)
+    kx, kc, kn, ks, kr = jax.random.split(key, 5)
+
+    if effective_rank is None:
+        X = jax.random.normal(kx, (n_samples, n_features), dtype)
+    else:
+        # low-rank X with bell-shaped singular profile (reference's
+        # make_low_rank_matrix path)
+        r = min(effective_rank, min(n_samples, n_features))
+        k1, k2 = jax.random.split(kx)
+        nmin = min(n_samples, n_features)
+        u, _ = jnp.linalg.qr(jax.random.normal(k1, (n_samples, nmin), jnp.float32))
+        v, _ = jnp.linalg.qr(jax.random.normal(k2, (n_features, nmin), jnp.float32))
+        idx = jnp.arange(nmin, dtype=jnp.float32)
+        low = jnp.exp(-((idx / r) ** 2))
+        tail = tail_strength * jnp.exp(-0.1 * idx / r)
+        s = (1.0 - tail_strength) * low + tail
+        X = ((u * s[None, :]) @ v.T).astype(dtype)
+
+    coef = jnp.zeros((n_features, n_targets), dtype)
+    coef = coef.at[:n_informative].set(
+        100.0 * jax.random.uniform(kc, (n_informative, n_targets), dtype)
+    )
+    y = X @ coef + jnp.asarray(bias, dtype)
+    if noise > 0:
+        y = y + noise * jax.random.normal(kn, y.shape, dtype)
+    if shuffle:
+        row_perm = jax.random.permutation(ks, n_samples)
+        col_perm = jax.random.permutation(kr, n_features)
+        X = X[row_perm][:, col_perm]
+        y = y[row_perm]
+        coef = coef[col_perm]
+    return X, y, coef
+
+
+def multi_variable_gaussian(
+    key: KeyLike,
+    n_samples: int,
+    mean: jax.Array,
+    cov: jax.Array,
+    method: str = "cholesky",
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Samples from N(mean, cov) — ``multi_variable_gaussian``
+    (``random/multi_variable_gaussian.cuh``; decomposition methods
+    cholesky / jacobi (eigen) mirror the reference's enum).
+
+    Returns ``[n_samples, dim]``.
+    """
+    mean = jnp.asarray(mean, jnp.float32)
+    cov = jnp.asarray(cov, jnp.float32)
+    d = mean.shape[0]
+    expects(cov.shape == (d, d), "cov must be [dim, dim]")
+    expects(method in ("cholesky", "jacobi"), "method must be cholesky|jacobi")
+    z = jax.random.normal(as_key(key), (n_samples, d), jnp.float32)
+    if method == "cholesky":
+        chol = jnp.linalg.cholesky(cov + 1e-8 * jnp.eye(d))
+        samples = z @ chol.T
+    else:  # eigendecomposition (the reference's jacobi path)
+        w, v = jnp.linalg.eigh(cov)
+        samples = z @ (v * jnp.sqrt(jnp.maximum(w, 0.0))[None, :]).T
+    return (samples + mean[None, :]).astype(dtype)

@@ -196,3 +196,49 @@ class TestCagraSearch:
         loaded2 = cagra.load(buf2, dataset=X)
         v3, i3 = cagra.search(loaded2, Q, k, p)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i3))
+
+
+class TestVpq:
+    """VPQ-compressed dataset (``neighbors/dataset.hpp:210-259``)."""
+
+    def test_compressed_search_recall(self, rng):
+        n, d, nq, k = 3000, 32, 64, 10
+        X = _data(rng, n, d, n_centers=16, scale=0.2)
+        Q = _data(rng, nq, d, n_centers=16, scale=0.2)
+        index = cagra.build(
+            X, cagra.CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=0)
+        )
+        comp = cagra.compress(index, cagra.VpqParams(pq_dim=8, seed=1))
+        assert comp.dataset is None and comp.vpq is not None
+        assert comp.vpq.codes.shape == (n, 8)
+        _, ref = brute_force.search(
+            brute_force.build(X, metric=DistanceType.L2Expanded), Q, k
+        )
+        _, ci = cagra.search(comp, Q, k, CagraSearchParams(itopk_size=64, search_width=2))
+        rec = float(neighborhood_recall(np.asarray(ci), np.asarray(ref)))
+        # PQ-quantized scoring costs recall vs exact; must stay useful
+        assert rec >= 0.6, rec
+        # and must roughly track the uncompressed search
+        _, ui = cagra.search(index, Q, k, CagraSearchParams(itopk_size=64, search_width=2))
+        urec = float(neighborhood_recall(np.asarray(ui), np.asarray(ref)))
+        assert rec >= urec - 0.3, (rec, urec)
+
+    def test_vpq_serialize_roundtrip(self, rng):
+        import io as _io
+
+        n, d = 1500, 16
+        X = _data(rng, n, d, n_centers=8)
+        index = cagra.build(
+            X, cagra.CagraIndexParams(intermediate_graph_degree=16, graph_degree=8, seed=0)
+        )
+        comp = cagra.compress(index, cagra.VpqParams(pq_dim=4, seed=1))
+        buf = _io.BytesIO()
+        cagra.save(comp, buf)
+        buf.seek(0)
+        loaded = cagra.load(buf)
+        assert loaded.vpq is not None and loaded.dataset is None
+        np.testing.assert_array_equal(np.asarray(loaded.vpq.codes), np.asarray(comp.vpq.codes))
+        Q = _data(rng, 16, d, n_centers=8)
+        v1, i1 = cagra.search(comp, Q, 5)
+        v2, i2 = cagra.search(loaded, Q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

@@ -158,3 +158,23 @@ def test_query_batching(data, index):
     d1, i1 = ivf_flat.search(index, queries, K, n_probes=8, query_batch=64)
     d2, i2 = ivf_flat.search(index, queries, K, n_probes=8, query_batch=NQ)
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+@pytest.mark.parametrize("dtype", [np.int8, np.uint8])
+def test_ivf_flat_integer_dtypes(rng, dtype):
+    """int8/uint8 list storage — the reference ships per-dtype IVF scan
+    kernels (``ivf_flat_interleaved_scan-inl.cuh:106-650``); here the
+    narrow dtype flows through packing and both search paths."""
+    n, d, nq, k = 3000, 16, 64, 5
+    lo, hi = (0, 60) if dtype == np.uint8 else (-30, 30)
+    X = rng.integers(lo, hi, (n, d)).astype(dtype)
+    Q = rng.integers(lo, hi, (nq, d)).astype(dtype)
+    index = ivf_flat.build(X, IvfFlatIndexParams(n_lists=32, seed=1))
+    assert index.list_data.dtype == dtype
+    from raft_tpu.neighbors import brute_force as bf_mod
+
+    _, ref = bf_mod.search(bf_mod.build(X.astype(np.float32), metric=DistanceType.L2Expanded), Q.astype(np.float32), k)
+    for mode in ("scan", "probe"):
+        _, i = ivf_flat.search(index, Q, k, n_probes=16, mode=mode)
+        rec = float(neighborhood_recall(np.asarray(i), np.asarray(ref)))
+        assert rec >= 0.95, (mode, rec)

@@ -104,3 +104,67 @@ def test_rmat_skew():
     # With a=0.9 nearly all mass lands in the low-index quadrants.
     src, dst = rr.rmat(0, 10000, r_scale=10, c_scale=10, a=0.9, b=0.04, c=0.04)
     assert np.median(np.asarray(src)) < 100
+
+
+class TestMakeRegression:
+    def test_linear_relation(self, rng):
+        from raft_tpu.random import make_regression
+
+        X, y, coef = make_regression(0, 200, 10, n_informative=5, noise=0.0, shuffle=False)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(X) @ np.asarray(coef), rtol=1e-4, atol=1e-3
+        )
+        # only n_informative coefficients non-zero
+        assert (np.asarray(coef)[5:] == 0).all()
+        assert (np.abs(np.asarray(coef)[:5]).sum(axis=1) > 0).all()
+
+    def test_shuffle_and_bias_noise(self, rng):
+        from raft_tpu.random import make_regression
+
+        X, y, coef = make_regression(1, 300, 8, bias=3.0, noise=0.1)
+        resid = np.asarray(y) - (np.asarray(X) @ np.asarray(coef) + 3.0)
+        assert 0.05 < resid.std() < 0.2  # noise scale respected
+
+    def test_effective_rank(self, rng):
+        from raft_tpu.random import make_regression
+
+        X, _, _ = make_regression(2, 300, 50, effective_rank=5, shuffle=False)
+        s = np.linalg.svd(np.asarray(X), compute_uv=False)
+        # energy concentrated in the top singular values relative to a
+        # full-rank gaussian (the profile keeps a fat tail by design,
+        # matching sklearn's make_low_rank_matrix)
+        Xf, _, _ = make_regression(2, 300, 50, shuffle=False)
+        sf = np.linalg.svd(np.asarray(Xf), compute_uv=False)
+        assert s[:10].sum() / s.sum() > 1.3 * (sf[:10].sum() / sf.sum())
+
+
+class TestMultiVariableGaussian:
+    def test_moments(self, rng):
+        from raft_tpu.random import multi_variable_gaussian
+
+        mean = np.array([1.0, -2.0, 0.5], np.float32)
+        A = rng.standard_normal((3, 3)).astype(np.float32)
+        cov = A @ A.T + 0.5 * np.eye(3, dtype=np.float32)
+        for method in ("cholesky", "jacobi"):
+            S = np.asarray(multi_variable_gaussian(0, 20000, mean, cov, method=method))
+            np.testing.assert_allclose(S.mean(0), mean, atol=0.15)
+            np.testing.assert_allclose(np.cov(S.T), cov, atol=0.3)
+
+
+class TestBatchKQuery:
+    def test_pages_match_full_search(self, rng):
+        from raft_tpu.neighbors import brute_force
+        from raft_tpu.neighbors.brute_force import BatchKQuery
+
+        X = rng.standard_normal((500, 16)).astype(np.float32)
+        Q = rng.standard_normal((20, 16)).astype(np.float32)
+        index = brute_force.build(X)
+        _, full = brute_force.search(index, Q, 96)
+        bq = BatchKQuery(index, Q, batch_size=32)
+        pages = [bq.batch(i) for i in range(3)]
+        got = np.concatenate([np.asarray(p.indices) for p in pages], axis=1)
+        np.testing.assert_array_equal(got, np.asarray(full))
+        assert pages[1].offset == 32
+        # iterator covers the whole index
+        total = sum(p.indices.shape[1] for p in BatchKQuery(index, Q, 128))
+        assert total == 500

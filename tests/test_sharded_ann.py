@@ -87,3 +87,18 @@ class TestShardedIvfPq:
         uv, ui = ivf_pq.search(index, Q, k, ivf_pq.IvfPqSearchParams(n_probes=32), mode="scan")
         rec = float(neighborhood_recall(np.asarray(si), np.asarray(ui)))
         assert rec >= 0.99, rec
+
+
+class TestShardedCagraVpq:
+    def test_vpq_index_works_sharded(self, setup):
+        mesh, X, Q = setup
+        k = 8
+        index = cagra.build(
+            X, cagra.CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=0)
+        )
+        comp = cagra.compress(index, cagra.VpqParams(pq_dim=8, seed=1))
+        sv, si = sharded_cagra_search(
+            mesh, comp, Q, k, cagra.CagraSearchParams(itopk_size=64, search_width=2)
+        )
+        assert si.shape == (Q.shape[0], k)
+        assert (np.asarray(si) >= 0).mean() > 0.95
