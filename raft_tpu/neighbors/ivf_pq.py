@@ -876,6 +876,13 @@ def search(
             return out_v[0], out_i[0]
         return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
 
+    # Probe mode gathers [qb, pq_dim, max_list] f32 LUT lanes per step; cap
+    # the batch so that temporary stays under ~512 MB (an uncapped 1024-
+    # query batch against 4k-row lists allocates gigabytes per probe and
+    # can OOM the chip — the scan path is the right tool there).
+    per_q = max(1, index.pq_dim * index.max_list * 4)
+    query_batch = max(1, min(query_batch, (512 << 20) // per_q))
+
     out_v, out_i = [], []
     for start in range(0, nq, query_batch):
         qc = queries[start : start + query_batch]
