@@ -1,0 +1,155 @@
+"""Primitive microbenchmarks — the ``cpp/bench/prims`` analog
+(``cpp/bench/prims/common/benchmark.hpp`` fixtures for
+``matrix/select_k.cu``, ``distance/fused_l2_nn.cu``,
+``cluster/kmeans_balanced.cu``, ``neighbors/*``).
+
+Each case times one primitive at a few representative shapes with the
+same pipelined-sync discipline as the L8 harness (dispatches are async;
+sync via a scalar fetch) and reports gbench-style entries.
+
+Run: ``python -m raft_tpu.bench.prims [--filter distance]``
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _timed(fn: Callable[[], object], inner: int = 8, reps: int = 2) -> float:
+    out = fn()
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = fn()
+        float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def _cases() -> List[Tuple[str, Callable[[], Tuple[Callable, Dict]]]]:
+    key = jax.random.PRNGKey(0)
+
+    def pairwise_distance():
+        from raft_tpu.ops.distance import DistanceType, pairwise_distance
+
+        m, n, d = 2048, 16384, 128
+        x = jax.random.normal(key, (m, d), jnp.float32)
+        y = jax.random.normal(key, (n, d), jnp.float32)
+        fn = jax.jit(lambda: pairwise_distance(x, y, DistanceType.L2Expanded))
+        return fn, {"items": m * n, "flop": 2 * m * n * d}
+
+    def fused_l2_nn():
+        from raft_tpu.ops.fused_1nn import fused_l2_nn as f
+
+        m, n, d = 65536, 1024, 128
+        x = jax.random.normal(key, (m, d), jnp.float32)
+        y = jax.random.normal(key, (n, d), jnp.float32)
+        fn = jax.jit(lambda: f(x, y))
+        return fn, {"items": m, "flop": 2 * m * n * d}
+
+    def masked_l2_nn():
+        from raft_tpu.ops.masked_nn import masked_l2_nn as f
+
+        m, n, d, ng = 16384, 16384, 64, 32
+        x = jax.random.normal(key, (m, d), jnp.float32)
+        y = jax.random.normal(key, (n, d), jnp.float32)
+        adj = jax.random.uniform(key, (m, ng)) < 0.5
+        gi = jnp.arange(1, ng + 1, dtype=jnp.int32) * (n // ng)
+        fn = lambda: f(x, y, adj, gi)
+        return fn, {"items": m}
+
+    def select_k_exact():
+        from raft_tpu.ops.select_k import select_k
+
+        b, n, k = 512, 65536, 64
+        v = jax.random.normal(key, (b, n), jnp.float32)
+        fn = jax.jit(lambda: select_k(v, k))
+        return fn, {"items": b * n}
+
+    def select_k_approx():
+        from raft_tpu.ops.select_k import approx_select_k
+
+        b, n, k = 512, 65536, 64
+        v = jax.random.normal(key, (b, n), jnp.float32)
+        fn = jax.jit(lambda: approx_select_k(v, k))
+        return fn, {"items": b * n}
+
+    def kmeans_balanced_fit():
+        from raft_tpu.cluster import kmeans_balanced
+        from raft_tpu.cluster.kmeans_balanced import BalancedKMeansParams
+
+        n, d, k = 65536, 64, 256
+        x = jax.random.normal(key, (n, d), jnp.float32)
+        fn = lambda: kmeans_balanced.fit(x, BalancedKMeansParams(n_clusters=k, n_iters=5))
+        return fn, {"items": n}
+
+    def rng_normal():
+        fn = jax.jit(lambda: jax.random.normal(key, (1 << 24,), jnp.float32))
+        return fn, {"items": 1 << 24}
+
+    def gram_rbf():
+        from raft_tpu.ops.kernels import rbf_kernel
+
+        m, n, d = 4096, 4096, 128
+        x = jax.random.normal(key, (m, d), jnp.float32)
+        y = jax.random.normal(key, (n, d), jnp.float32)
+        fn = jax.jit(lambda: rbf_kernel(x, y, gamma=0.1))
+        return fn, {"items": m * n, "flop": 2 * m * n * d}
+
+    return [
+        ("distance/pairwise_l2", pairwise_distance),
+        ("distance/fused_l2_nn", fused_l2_nn),
+        ("distance/masked_l2_nn", masked_l2_nn),
+        ("matrix/select_k_exact", select_k_exact),
+        ("matrix/select_k_approx", select_k_approx),
+        ("cluster/kmeans_balanced", kmeans_balanced_fit),
+        ("random/normal_16M", rng_normal),
+        ("distance/gram_rbf", gram_rbf),
+    ]
+
+
+def run(filter_substr: str = "", inner: int = 8) -> List[Dict]:
+    results = []
+    for name, make in _cases():
+        if filter_substr and filter_substr not in name:
+            continue
+        fn, meta = make()
+        dt = _timed(fn, inner=inner)
+        row = {
+            "name": name,
+            "real_time": dt,
+            "time_unit": "s",
+            "items_per_second": meta.get("items", 0) / dt,
+        }
+        if "flop" in meta:
+            row["tflops"] = round(meta["flop"] / dt / 1e12, 2)
+        results.append(row)
+        extra = f"  {row['tflops']} TFLOP/s" if "tflops" in row else ""
+        print(f"# {name:28s} {dt*1e3:10.2f} ms  {row['items_per_second']:>16,.0f} items/s{extra}", flush=True)
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("raft_tpu.bench.prims")
+    ap.add_argument("--filter", default="", help="substring filter on case names")
+    ap.add_argument("--inner", type=int, default=8)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    results = run(args.filter, args.inner)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"benchmarks": results}, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
