@@ -19,7 +19,7 @@ import numpy as np
 
 # Serialization format version tag written by dump_header; bump on breaking
 # layout changes (the reference keeps a per-index `serialization_version`).
-SERIALIZATION_VERSION = 2
+SERIALIZATION_VERSION = 3
 _MAGIC = b"RAFT_TPU"
 
 

@@ -27,7 +27,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.ops.distance import DistanceType
 from raft_tpu.utils.math import round_up
+
+
+def coarse_scores(centers, qf, metric) -> jax.Array:
+    """[nq, n_lists] coarse scores, smaller = better — the shared
+    ``select_clusters`` ranking (``ivf_pq_search.cuh:67``) used by the
+    probe mask, the fused Pallas path, and IVF-PQ. For cosine, ``qf`` must
+    already be unit-normalized (centers trained on normalized data)."""
+    q_dot_c = qf @ centers.T
+    if metric == DistanceType.InnerProduct:
+        return -q_dot_c
+    c_norm = jnp.sum(centers * centers, axis=1)
+    return c_norm[None, :] - 2.0 * q_dot_c
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

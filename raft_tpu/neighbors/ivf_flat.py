@@ -75,9 +75,20 @@ class IvfFlatIndexParams:
 
 @dataclasses.dataclass
 class IvfFlatSearchParams:
-    """``ivf_flat::search_params`` analog (``ivf_flat_types.hpp:155``)."""
+    """``ivf_flat::search_params`` analog (``ivf_flat_types.hpp:155``).
+
+    The ``fused_*`` knobs tune the Pallas fused scan (``mode="fused"``):
+    query-tile height, tile probe-table size (``fused_probe_factor *
+    n_probes`` lists per tile), top-k merge strategy (``"seg"`` lane-group
+    PartialReduce or ``"exact"``), and MXU precision for the distance
+    matmul (``"highest"`` = f32-exact passes, ``"default"`` = fast)."""
 
     n_probes: int = 20
+    fused_qt: int = 64
+    fused_probe_factor: int = 4
+    fused_group: int = 1  # lists per DMA block / probe-table entry
+    fused_merge: str = "seg"
+    fused_precision: str = "highest"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -93,16 +104,34 @@ class IvfFlatIndex:
     metric: DistanceType
     size: int  # total indexed rows
     list_cap_factor: float = 2.0  # build-time cap; honored by extend()
+    # PCA-bisection spatial rank of the centers (see
+    # raft_tpu.ops.pallas.spatial_center_rank); used by the fused Pallas
+    # search path to form probe-coherent query tiles. Optional: computed at
+    # build, regenerated on demand for indexes loaded from old files.
+    center_rank: Optional[jax.Array] = None
 
     def tree_flatten(self):
         return (
-            (self.centers, self.list_data, self.list_indices, self.list_sizes, self.list_norms),
+            (
+                self.centers,
+                self.list_data,
+                self.list_indices,
+                self.list_sizes,
+                self.list_norms,
+                self.center_rank,
+            ),
             (self.metric, self.size, self.list_cap_factor),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, metric=aux[0], size=aux[1], list_cap_factor=aux[2])
+        return cls(
+            *children[:5],
+            metric=aux[0],
+            size=aux[1],
+            list_cap_factor=aux[2],
+            center_rank=children[5],
+        )
 
     @property
     def n_lists(self) -> int:
@@ -156,6 +185,16 @@ def build(
             seed=params.seed,
         ),
     )
+    # Physically order the lists by the PCA-bisection spatial rank of their
+    # centers, so spatially nearby lists get nearby indices. The fused
+    # Pallas path depends on this: probe-coherent query tiles and
+    # group-granular probe tables both assume neighbor lists sit next to
+    # each other in the layout. (List order is meaningless to every other
+    # path, so this is free.)
+    from raft_tpu.ops.pallas import spatial_center_rank
+
+    rank = spatial_center_rank(np.asarray(centers))
+    centers = jnp.asarray(np.asarray(centers)[np.argsort(rank)])
     cand = _topk_labels(assign_data, centers)
     list_data, list_indices, list_sizes, _ = _pack(
         dataset, jnp.arange(n, dtype=jnp.int32), cand, n_lists, params.list_cap_factor
@@ -163,6 +202,8 @@ def build(
     list_norms = None
     if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded, DistanceType.CosineExpanded):
         list_norms = row_norms(list_data.reshape(-1, d)).reshape(list_data.shape[:2])
+    # lists are stored in spatial order, so the rank is the identity
+    center_rank = jnp.arange(n_lists, dtype=jnp.int32)
     return IvfFlatIndex(
         centers=centers,
         list_data=list_data,
@@ -172,6 +213,7 @@ def build(
         metric=metric,
         size=n,
         list_cap_factor=params.list_cap_factor,
+        center_rank=center_rank,
     )
 
 
@@ -226,6 +268,7 @@ def extend(
         metric=index.metric,
         size=index.size + n_new,
         list_cap_factor=cap_factor,
+        center_rank=index.center_rank,
     )
 
 
@@ -292,14 +335,11 @@ def probe_mask(centers, qf, n_probes: int, metric: DistanceType) -> jax.Array:
     """[nq, n_lists] bool — which lists each query probes (the coarse
     ``select_clusters`` step as a mask). For cosine, ``qf`` must already be
     unit-normalized."""
+    from raft_tpu.neighbors.ivf_common import coarse_scores
+
     nq = qf.shape[0]
     n_lists = centers.shape[0]
-    q_dot_c = qf @ centers.T
-    if metric == DistanceType.InnerProduct:
-        coarse = -q_dot_c
-    else:
-        c_norm = jnp.sum(centers * centers, axis=1)
-        coarse = c_norm[None, :] - 2.0 * q_dot_c
+    coarse = coarse_scores(centers, qf, metric)
     if n_probes < n_lists:
         _, probes = select_k(coarse, n_probes, select_min=True)
         return jnp.zeros((nq, n_lists), bool).at[
@@ -498,13 +538,17 @@ def search(
     ``(distances [nq, k] f32, indices [nq, k] i32)``; unfilled slots get
     id -1.
 
-    ``mode``: ``"scan"`` = dense masked scan over list chunks (the
-    TPU-fast throughput path, see :func:`_ivf_flat_scan_impl`);
-    ``"probe"`` = per-probe gather (latency path for small batches);
-    ``"auto"`` picks scan for batches >= 128 queries. Both draw from the
-    same probed candidate set, but the scan path selects with the fused
-    APPROXIMATE top-k (per-chunk recall target 0.99 on a 2k shortlist),
-    so results can differ slightly from the deterministic probe path."""
+    ``mode``: ``"fused"`` = the Pallas fused probed-list scan (DMAs only
+    the probed lists — the big-batch TPU fast path, see
+    :mod:`raft_tpu.ops.pallas.ivf_scan`); ``"scan"`` = dense masked scan
+    over list chunks (:func:`_ivf_flat_scan_impl`); ``"probe"`` = per-probe
+    gather (latency path for small batches); ``"auto"`` picks fused on TPU
+    for batches >= 128 (when the metric/dtype qualify and there is no
+    prefilter fallback issue), else scan for batches >= 128, else probe.
+    All draw from the same probed candidate set; fused/scan select with an
+    approximate top-k (lane-group PartialReduce), so results can differ
+    slightly from the deterministic probe path. Fused accepts
+    ``params.fused_*`` tuning knobs and runs in interpret mode off-TPU."""
     ensure_resources(res)
     if params is None:
         params = IvfFlatSearchParams(**kwargs)
@@ -519,8 +563,66 @@ def search(
     filter_bits = prefilter.bits if prefilter is not None else None
 
     if mode == "auto":
-        mode = "scan" if nq >= 128 else "probe"
-    expects(mode in ("scan", "probe"), "mode must be auto|scan|probe, got %r", mode)
+        from raft_tpu.ops.pallas.ivf_scan import supported_metric
+
+        if (
+            nq >= 128
+            and jax.default_backend() == "tpu"
+            and supported_metric(index.metric)
+        ):
+            mode = "fused"
+        else:
+            mode = "scan" if nq >= 128 else "probe"
+    expects(
+        mode in ("scan", "probe", "fused"), "mode must be auto|scan|probe|fused, got %r", mode
+    )
+    if mode == "fused":
+        from raft_tpu.ops.pallas.ivf_scan import (
+            ivf_flat_fused_search,
+            spatial_center_rank,
+            supported_metric,
+        )
+
+        expects(supported_metric(index.metric), "fused mode: unsupported metric")
+        rank = index.center_rank
+        if rank is None:
+            # legacy (pre-v3) index: compute once and cache on the object so
+            # serving loops don't pay the host-side PCA walk per call
+            rank = jnp.asarray(spatial_center_rank(np.asarray(index.centers)))
+            index.center_rank = rank
+        out_v, out_i = [], []
+        for start in range(0, nq, query_batch):
+            qc = queries[start : start + query_batch]
+            bpad = 0
+            if qc.shape[0] < query_batch and nq > query_batch:
+                bpad = query_batch - qc.shape[0]
+                qc = jnp.pad(qc, ((0, bpad), (0, 0)))
+            v, i = ivf_flat_fused_search(
+                index.centers,
+                rank,
+                index.list_data,
+                index.list_indices,
+                index.list_norms,
+                qc,
+                filter_bits,
+                k=k,
+                n_probes=n_probes,
+                metric=index.metric,
+                qt=params.fused_qt,
+                probe_factor=params.fused_probe_factor,
+                group=min(params.fused_group, index.n_lists),
+                has_filter=filter_bits is not None,
+                merge=params.fused_merge,
+                precision=params.fused_precision,
+                interpret=jax.default_backend() != "tpu",
+            )
+            if bpad:
+                v, i = v[:-bpad], i[:-bpad]
+            out_v.append(v)
+            out_i.append(i)
+        if len(out_v) == 1:
+            return out_v[0], out_i[0]
+        return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
     if mode == "scan":
         g = scan_chunk_lists(index.n_lists, index.max_list)
         out_v, out_i = [], []
@@ -582,7 +684,7 @@ def search(
 # -- serialization (neighbors/ivf_flat_serialize.cuh analog) ----------------
 
 _KIND = "ivf_flat"
-_VERSION = 2
+_VERSION = 3
 
 
 def save(index: IvfFlatIndex, stream: BinaryIO) -> None:
@@ -591,12 +693,15 @@ def save(index: IvfFlatIndex, stream: BinaryIO) -> None:
     ser.serialize_scalar(stream, int(index.size), "int64")
     ser.serialize_scalar(stream, float(index.list_cap_factor), "float64")
     ser.serialize_scalar(stream, int(index.list_norms is not None), "int32")
+    ser.serialize_scalar(stream, int(index.center_rank is not None), "int32")
     ser.serialize_array(stream, index.centers)
     ser.serialize_array(stream, index.list_data)
     ser.serialize_array(stream, index.list_indices)
     ser.serialize_array(stream, index.list_sizes)
     if index.list_norms is not None:
         ser.serialize_array(stream, index.list_norms)
+    if index.center_rank is not None:
+        ser.serialize_array(stream, index.center_rank)
 
 
 def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfFlatIndex:
@@ -606,11 +711,13 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfFlatIndex:
     size = int(ser.deserialize_scalar(stream, "int64"))
     cap_factor = float(ser.deserialize_scalar(stream, "float64")) if version >= 2 else 2.0
     has_norms = bool(ser.deserialize_scalar(stream, "int32"))
+    has_rank = bool(ser.deserialize_scalar(stream, "int32")) if version >= 3 else False
     centers = ser.deserialize_array(stream)
     list_data = ser.deserialize_array(stream)
     list_indices = ser.deserialize_array(stream)
     list_sizes = ser.deserialize_array(stream)
     list_norms = ser.deserialize_array(stream) if has_norms else None
+    center_rank = ser.deserialize_array(stream) if has_rank else None
     return IvfFlatIndex(
         centers=centers,
         list_data=list_data,
@@ -620,4 +727,5 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfFlatIndex:
         metric=metric,
         size=size,
         list_cap_factor=cap_factor,
+        center_rank=center_rank,
     )

@@ -1,0 +1,22 @@
+"""Hand-written Pallas (Mosaic) TPU kernels for the hot search paths.
+
+The XLA paths in :mod:`raft_tpu.neighbors` express everything as dense
+masked matmuls because XLA cannot gather *only* the probed IVF lists
+efficiently. Pallas can: a scalar-prefetch grid spec lets the block index
+map read the probe table, so the DMA engine streams exactly the probed
+lists from HBM into VMEM — the TPU answer to the reference's fused
+interleaved-scan CUDA kernel (``ivf_flat_interleaved_scan-inl.cuh:687``),
+with the reference's per-(query,probe) kernel grid replaced by a
+(query-tile, probe-slot) grid over DMA'd list blocks.
+"""
+from raft_tpu.ops.pallas.ivf_scan import (
+    fused_list_topk,
+    ivf_flat_fused_search,
+    spatial_center_rank,
+)
+
+__all__ = [
+    "fused_list_topk",
+    "ivf_flat_fused_search",
+    "spatial_center_rank",
+]

@@ -114,13 +114,16 @@ def sharded_ivf_flat_search(
 
 
 @functools.lru_cache(maxsize=64)
-def _cagra_fn(mesh, axis, k, itopk, width, iters, n_init, size, metric, seed, use_vpq):
+def _cagra_fn(mesh, axis, k, itopk, width, iters, n_init, size, metric, seed, use_vpq, init_sample):
     key = as_key(seed)
 
     def local(sqnorms, graph, q, *data_args):
         rank = lax.axis_index(axis)
         kb = jax.random.fold_in(key, rank)
-        init_ids = jax.random.randint(kb, (q.shape[0], n_init), 0, size, jnp.int32)
+        if init_sample > 0:
+            init_ids = cagra_mod.strided_seed_ids(size, init_sample)
+        else:
+            init_ids = jax.random.randint(kb, (q.shape[0], n_init), 0, size, jnp.int32)
         if use_vpq:
             dataset, vpq_arrays = None, tuple(data_args)
         else:
@@ -168,7 +171,7 @@ def sharded_cagra_search(
         expects(index.vpq is not None, "index has neither dataset nor vpq data")
     fn = _cagra_fn(
         mesh, axis, k, itopk, width, iters, n_init, index.size, index.metric,
-        params.seed, use_vpq,
+        params.seed, use_vpq, params.init_sample,
     )
     put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
     if use_vpq:

@@ -1,0 +1,172 @@
+"""Tests for the Pallas fused probed-list scan (interpret mode on CPU).
+
+Mirrors the reference's recall-threshold testing for the fused
+interleaved-scan kernel (``cpp/test/neighbors/ann_ivf_flat``) plus exact
+checks: with every list probed and ``merge="exact"`` the kernel must
+reproduce brute force bit-for-bit (CPU interpret arithmetic is exact)."""
+import io
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.ops.distance import DistanceType
+from raft_tpu.ops.pallas import ivf_flat_fused_search, spatial_center_rank
+from raft_tpu.stats import neighborhood_recall
+
+ALL_METRICS = [
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.InnerProduct,
+    DistanceType.CosineExpanded,
+]
+
+
+def _data(n=2000, d=32, nq=100, n_centers=20, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32)
+    ds = (centers[rng.integers(0, n_centers, n)] + 0.4 * rng.standard_normal((n, d))).astype(
+        np.float32
+    )
+    qs = (centers[rng.integers(0, n_centers, nq)] + 0.4 * rng.standard_normal((nq, d))).astype(
+        np.float32
+    )
+    return ds, qs
+
+
+@pytest.mark.parametrize("metric", ALL_METRICS)
+def test_fused_all_probes_matches_brute_force(metric):
+    ds, qs = _data()
+    k = 10
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=16, metric=metric, seed=1))
+    assert idx.center_rank is not None
+    v, i = ivf_flat_fused_search(
+        idx.centers,
+        idx.center_rank,
+        idx.list_data,
+        idx.list_indices,
+        idx.list_norms,
+        qs,
+        None,
+        k=k,
+        n_probes=16,
+        metric=metric,
+        qt=8,
+        probe_factor=16,
+        merge="exact",
+        interpret=True,
+    )
+    bf = brute_force.build(ds, metric=metric)
+    bv, bi = brute_force.search(bf, qs, k)
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(bi)))
+    assert rec > 0.999, (metric, rec)
+    fin = np.isfinite(np.asarray(bv))
+    np.testing.assert_allclose(
+        np.asarray(v)[fin], np.asarray(bv)[fin], rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("metric", ALL_METRICS)
+def test_fused_seg_merge_vs_probe_path(metric):
+    ds, qs = _data(seed=2)
+    k = 10
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=16, metric=metric, seed=1))
+    v, i = ivf_flat.search(
+        idx,
+        qs,
+        k,
+        ivf_flat.IvfFlatSearchParams(n_probes=6, fused_qt=8, fused_probe_factor=4),
+        mode="fused",
+    )
+    pv, pi = ivf_flat.search(idx, qs, k, n_probes=6, mode="probe")
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(pi)))
+    assert rec > 0.92, (metric, rec)
+
+
+def test_fused_ragged_batch_and_tiny_k():
+    ds, qs = _data(nq=37, seed=3)  # not a multiple of the tile height
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=1))
+    v, i = ivf_flat.search(
+        idx,
+        qs,
+        3,
+        ivf_flat.IvfFlatSearchParams(n_probes=8, fused_qt=8, fused_probe_factor=8, fused_merge="exact"),
+        mode="fused",
+    )
+    bf = brute_force.build(ds, metric=DistanceType.L2Expanded)
+    _, bi = brute_force.search(bf, qs, 3)
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(bi)))
+    assert rec > 0.999, rec
+
+
+def test_fused_prefilter():
+    from raft_tpu.core.bitset import Bitset
+
+    ds, qs = _data(seed=4)
+    k = 5
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=1))
+    # filter out the exact top-1 of every query, fused must return the rest
+    bf = brute_force.build(ds, metric=DistanceType.L2Expanded)
+    _, bi = brute_force.search(bf, qs, 1)
+    banned = np.unique(np.asarray(bi).ravel())
+    flt = Bitset.from_unset_indices(ds.shape[0], jnp.asarray(banned))
+    v, i = ivf_flat.search(
+        idx,
+        qs,
+        k,
+        ivf_flat.IvfFlatSearchParams(n_probes=8, fused_qt=8, fused_probe_factor=8, fused_merge="exact"),
+        prefilter=flt,
+        mode="fused",
+    )
+    got = np.asarray(i)
+    assert not np.isin(got, banned).any()
+    # and matches filtered brute force
+    fv, fi = brute_force.search(bf, qs, k, prefilter=flt)
+    rec = float(neighborhood_recall(got, np.asarray(fi)))
+    assert rec > 0.999, rec
+
+
+def test_center_rank_serialization_roundtrip():
+    ds, _ = _data(n=500, seed=5)
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=1))
+    buf = io.BytesIO()
+    ivf_flat.save(idx, buf)
+    buf.seek(0)
+    idx2 = ivf_flat.load(buf)
+    assert idx2.center_rank is not None
+    np.testing.assert_array_equal(np.asarray(idx.center_rank), np.asarray(idx2.center_rank))
+
+
+def test_spatial_center_rank_is_permutation():
+    rng = np.random.default_rng(0)
+    c = rng.standard_normal((37, 16))
+    r = spatial_center_rank(c)
+    assert sorted(r.tolist()) == list(range(37))
+    # spatially coherent: adjacent ranks are closer on average than random pairs
+    order = np.argsort(r)
+    adjacent = np.linalg.norm(c[order[1:]] - c[order[:-1]], axis=1).mean()
+    perm = rng.permutation(37)
+    rand = np.linalg.norm(c[perm[1:]] - c[perm[:-1]], axis=1).mean()
+    assert adjacent < rand
+
+
+def test_fused_int8_lists():
+    rng = np.random.default_rng(6)
+    ds = rng.integers(-30, 30, (1500, 32)).astype(np.int8)
+    qs = rng.integers(-30, 30, (64, 32)).astype(np.int8)
+    k = 5
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=1))
+    v, i = ivf_flat.search(
+        idx,
+        qs,
+        k,
+        ivf_flat.IvfFlatSearchParams(n_probes=8, fused_qt=8, fused_probe_factor=8, fused_merge="exact"),
+        mode="fused",
+    )
+    bf = brute_force.build(ds, metric=DistanceType.L2Expanded)
+    _, bi = brute_force.search(bf, qs, k)
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(bi)))
+    assert rec > 0.99, rec
