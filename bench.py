@@ -17,6 +17,7 @@ Everything (data gen, builds, searches) runs on-device; only [nq, k]
 results and scalars cross the host link (which on tethered dev TPUs is
 ~2 MB/s — the round-2 bench lost minutes to transfers).
 """
+import dataclasses
 import json
 import os
 import time
@@ -112,9 +113,24 @@ def main():
     )
     float(jnp.sum(fidx.list_sizes))
     build_times["ivf_flat"] = round(time.perf_counter() - t0, 1)
-    for npr in (10, 20, 50):
-        dt, (v, i) = _timed(lambda npr=npr: ivf_flat.search(fidx, queries, K, n_probes=npr))
-        record("ivf_flat", f"nprobe={npr}", dt, i)
+    # fused Pallas probed-list scan, bf16 lists (the TPU fast path)
+    bf16_idx = dataclasses.replace(fidx, list_data=fidx.list_data.astype(jnp.bfloat16))
+    for npr, pf, g, qt, merge in (
+        (20, 64, 8, 128, "seg"),
+        (20, 32, 8, 128, "seg4"),
+        (50, 32, 8, 128, "seg"),
+    ):
+        sp = ivf_flat.IvfFlatSearchParams(
+            n_probes=npr, fused_qt=qt, fused_probe_factor=pf, fused_group=g,
+            fused_merge=merge, fused_precision="default",
+        )
+        dt, (v, i) = _timed(
+            lambda sp=sp: ivf_flat.search(bf16_idx, queries, K, sp, mode="fused")
+        )
+        record("ivf_flat", f"fused bf16 npr={npr} pf={pf} G={g} {merge}", dt, i)
+    for npr in (10, 20):
+        dt, (v, i) = _timed(lambda npr=npr: ivf_flat.search(fidx, queries, K, n_probes=npr, mode="scan"))
+        record("ivf_flat", f"scan nprobe={npr}", dt, i)
 
     t0 = time.perf_counter()
     pidx = ivf_pq.build(
@@ -153,7 +169,7 @@ def main():
         )
         float(jnp.sum(cidx.graph[0].astype(jnp.float32)))
         build_times["cagra"] = round(time.perf_counter() - t0, 1)
-        for itopk, w in ((64, 2), (128, 4)):
+        for itopk, w in ((128, 4), (192, 4)):
             dt, (v, i) = _timed(
                 lambda itopk=itopk, w=w: cagra.search(
                     cidx, queries, K, cagra.CagraSearchParams(itopk_size=itopk, search_width=w)

@@ -21,9 +21,16 @@ GROUPS: Dict[str, Dict[str, Dict[str, Dict[str, Sequence[Any]]]]] = {
             "search": {"mode": ["approx"]},
         },
         "raft_ivf_flat": {
-            # raft_ivf_flat.yaml: nlist [1024,2048,4096], ratio, niter
-            "build": {"nlist": [1024, 2048], "ratio": [4], "niter": [20]},
-            "search": {"nprobe": [5, 10, 20, 50, 100]},
+            # raft_ivf_flat.yaml: nlist [1024,2048,4096], ratio, niter;
+            # list_dtype half + the fused-scan knobs are TPU additions
+            "build": {"nlist": [1024, 2048], "ratio": [4], "niter": [20], "list_dtype": ["float", "half"]},
+            "search": {
+                "nprobe": [5, 10, 20, 50, 100],
+                "fused_group": [8],
+                "fused_qt": [128],
+                "fused_pf": [16, 32],
+                "fused_precision": ["default"],
+            },
         },
         "raft_ivf_pq": {
             # raft_ivf_pq.yaml:1-17

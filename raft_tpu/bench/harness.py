@@ -58,9 +58,13 @@ def _search_brute_force(index, queries, k: int, p: Dict[str, Any], batch: int):
 
 
 def _build_ivf_flat(ds: Dataset, p: Dict[str, Any]):
+    import dataclasses
+
+    import jax.numpy as jnp
+
     from raft_tpu.neighbors import ivf_flat
 
-    return ivf_flat.build(
+    index = ivf_flat.build(
         ds.base,
         ivf_flat.IvfFlatIndexParams(
             n_lists=p.get("nlist", 1024),
@@ -69,6 +73,11 @@ def _build_ivf_flat(ds: Dataset, p: Dict[str, Any]):
             kmeans_trainset_fraction=1.0 / p.get("ratio", 2),
         ),
     )
+    if p.get("list_dtype") == "half":
+        # bf16 lists halve fused-scan DMA bytes (see docs/tpu_design.md);
+        # the reference's half-precision list analog
+        index = dataclasses.replace(index, list_data=index.list_data.astype(jnp.bfloat16))
+    return index
 
 
 def _search_ivf_flat(index, queries, k: int, p: Dict[str, Any], batch: int):
@@ -78,8 +87,16 @@ def _search_ivf_flat(index, queries, k: int, p: Dict[str, Any], batch: int):
         index,
         queries,
         k,
-        ivf_flat.IvfFlatSearchParams(n_probes=p.get("nprobe", 20)),
+        ivf_flat.IvfFlatSearchParams(
+            n_probes=p.get("nprobe", 20),
+            fused_qt=p.get("fused_qt", 64),
+            fused_probe_factor=p.get("fused_pf", 4),
+            fused_group=p.get("fused_group", 1),
+            fused_merge=p.get("fused_merge", "seg"),
+            fused_precision=p.get("fused_precision", "highest"),
+        ),
         query_batch=batch,
+        mode=p.get("mode", "auto"),
     )
 
 

@@ -501,14 +501,14 @@ def _cagra_search_impl(
 
 
 def strided_seed_ids(size: int, sample: int) -> jnp.ndarray:
-    """Evenly spread seed ids with a CEIL stride so the arithmetic
-    progression wraps modulo ``size`` and covers the whole id range (a
-    floor stride would only ever touch the first ``sample * step`` rows —
-    fatal when the build order groups clusters). Shared by the local and
-    sharded search paths (``dev_seed`` analog, ``search_plan.cuh:100``)."""
+    """``min(sample, size)`` DISTINCT evenly spaced seed ids:
+    ``floor(i * size / sample)`` — covers the whole id range whatever the
+    build order groups (a fixed integer stride either truncates coverage
+    or collapses onto a subgroup when it divides ``size``). Shared by the
+    local and sharded search paths (``dev_seed`` analog,
+    ``search_plan.cuh:100``)."""
     s = min(sample, size)
-    step = max(1, -(-size // s))
-    return (jnp.arange(s, dtype=jnp.int32) * step) % size
+    return ((jnp.arange(s, dtype=jnp.int64) * size) // s).astype(jnp.int32)
 
 
 def derive_search_config(params: "CagraSearchParams", k: int, size: int):

@@ -43,6 +43,26 @@ def coarse_scores(centers, qf, metric) -> jax.Array:
     return c_norm[None, :] - 2.0 * q_dot_c
 
 
+def probe_selection(centers, qf, n_probes: int, metric) -> Tuple[jax.Array, jax.Array]:
+    """``(coarse [nq, n_lists], probed [nq, n_lists] bool)`` — the shared
+    coarse ranking plus the per-query probe mask (``select_clusters``,
+    ``ivf_flat_search-inl.cuh:145``). Single home for probe selection so
+    the scan, probe, and fused paths cannot diverge."""
+    from raft_tpu.ops.select_k import select_k
+
+    nq = qf.shape[0]
+    n_lists = centers.shape[0]
+    coarse = coarse_scores(centers, qf, metric)
+    if n_probes < n_lists:
+        _, probes = select_k(coarse, n_probes, select_min=True)
+        probed = jnp.zeros((nq, n_lists), bool).at[
+            jnp.arange(nq)[:, None], probes
+        ].set(True)
+    else:
+        probed = jnp.ones((nq, n_lists), bool)
+    return coarse, probed
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def _topk_block(xb, centers, cn, *, k: int):
     score = 2.0 * (xb @ centers.T) - cn[None, :]  # max == nearest

@@ -79,8 +79,8 @@ class IvfFlatSearchParams:
 
     The ``fused_*`` knobs tune the Pallas fused scan (``mode="fused"``):
     query-tile height, tile probe-table size (``fused_probe_factor *
-    n_probes`` lists per tile), top-k merge strategy (``"seg"`` lane-group
-    PartialReduce or ``"exact"``), and MXU precision for the distance
+    n_probes`` lists per tile), top-k merge strategy (``"seg"``/``"seg1"``/``"seg4"``
+    banked lane-group PartialReduce or ``"exact"``), and MXU precision for the distance
     matmul (``"highest"`` = f32-exact passes, ``"default"`` = fast)."""
 
     n_probes: int = 20
@@ -195,7 +195,7 @@ def build(
 
     rank = spatial_center_rank(np.asarray(centers))
     centers = jnp.asarray(np.asarray(centers)[np.argsort(rank)])
-    cand = _topk_labels(assign_data, centers)
+    cand = _topk_labels(assign_data, centers, k=8)
     list_data, list_indices, list_sizes, _ = _pack(
         dataset, jnp.arange(n, dtype=jnp.int32), cand, n_lists, params.list_cap_factor
     )
@@ -251,7 +251,7 @@ def extend(
     assign = all_data.astype(jnp.float32)
     if index.metric == DistanceType.CosineExpanded:
         assign = assign / jnp.maximum(jnp.linalg.norm(assign, axis=1, keepdims=True), 1e-12)
-    cand = _topk_labels(assign, index.centers)
+    cand = _topk_labels(assign, index.centers, k=8)
 
     list_data, list_indices, list_sizes, _ = _pack(
         all_data, all_ids, cand, index.n_lists, cap_factor
@@ -335,17 +335,9 @@ def probe_mask(centers, qf, n_probes: int, metric: DistanceType) -> jax.Array:
     """[nq, n_lists] bool — which lists each query probes (the coarse
     ``select_clusters`` step as a mask). For cosine, ``qf`` must already be
     unit-normalized."""
-    from raft_tpu.neighbors.ivf_common import coarse_scores
+    from raft_tpu.neighbors.ivf_common import probe_selection
 
-    nq = qf.shape[0]
-    n_lists = centers.shape[0]
-    coarse = coarse_scores(centers, qf, metric)
-    if n_probes < n_lists:
-        _, probes = select_k(coarse, n_probes, select_min=True)
-        return jnp.zeros((nq, n_lists), bool).at[
-            jnp.arange(nq)[:, None], probes
-        ].set(True)
-    return jnp.ones((nq, n_lists), bool)
+    return probe_selection(centers, qf, n_probes, metric)[1]
 
 
 def flat_scan_core(
@@ -522,6 +514,28 @@ def _ivf_search_impl(
     return vals, idx
 
 
+def _batched_search(run, queries, query_batch: int):
+    """Shared query-batching: pad the tail batch, call ``run`` per batch,
+    trim, concatenate. One home for the loop the fused/scan/probe modes
+    all need."""
+    nq = queries.shape[0]
+    out_v, out_i = [], []
+    for start in range(0, nq, query_batch):
+        qc = queries[start : start + query_batch]
+        bpad = 0
+        if qc.shape[0] < query_batch and nq > query_batch:
+            bpad = query_batch - qc.shape[0]
+            qc = jnp.pad(qc, ((0, bpad), (0, 0)))
+        v, i = run(qc)
+        if bpad:
+            v, i = v[:-bpad], i[:-bpad]
+        out_v.append(v)
+        out_i.append(i)
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
+
+
 def search(
     index: IvfFlatIndex,
     queries,
@@ -590,14 +604,13 @@ def search(
             # serving loops don't pay the host-side PCA walk per call
             rank = jnp.asarray(spatial_center_rank(np.asarray(index.centers)))
             index.center_rank = rank
-        out_v, out_i = [], []
-        for start in range(0, nq, query_batch):
-            qc = queries[start : start + query_batch]
-            bpad = 0
-            if qc.shape[0] < query_batch and nq > query_batch:
-                bpad = query_batch - qc.shape[0]
-                qc = jnp.pad(qc, ((0, bpad), (0, 0)))
-            v, i = ivf_flat_fused_search(
+        # round the DMA group down to a divisor of n_lists
+        group = max(1, min(params.fused_group, index.n_lists))
+        while index.n_lists % group:
+            group -= 1
+
+        def run(qc):
+            return ivf_flat_fused_search(
                 index.centers,
                 rank,
                 index.list_data,
@@ -610,29 +623,19 @@ def search(
                 metric=index.metric,
                 qt=params.fused_qt,
                 probe_factor=params.fused_probe_factor,
-                group=min(params.fused_group, index.n_lists),
+                group=group,
                 has_filter=filter_bits is not None,
                 merge=params.fused_merge,
                 precision=params.fused_precision,
                 interpret=jax.default_backend() != "tpu",
             )
-            if bpad:
-                v, i = v[:-bpad], i[:-bpad]
-            out_v.append(v)
-            out_i.append(i)
-        if len(out_v) == 1:
-            return out_v[0], out_i[0]
-        return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
+
+        return _batched_search(run, queries, query_batch)
     if mode == "scan":
         g = scan_chunk_lists(index.n_lists, index.max_list)
-        out_v, out_i = [], []
-        for start in range(0, nq, query_batch):
-            qc = queries[start : start + query_batch]
-            bpad = 0
-            if qc.shape[0] < query_batch and nq > query_batch:
-                bpad = query_batch - qc.shape[0]
-                qc = jnp.pad(qc, ((0, bpad), (0, 0)))
-            v, i = _ivf_flat_scan_impl(
+
+        def run_scan(qc):
+            return _ivf_flat_scan_impl(
                 index.centers,
                 index.list_data,
                 index.list_indices,
@@ -645,22 +648,11 @@ def search(
                 has_filter=filter_bits is not None,
                 chunk_lists=g,
             )
-            if bpad:
-                v, i = v[:-bpad], i[:-bpad]
-            out_v.append(v)
-            out_i.append(i)
-        if len(out_v) == 1:
-            return out_v[0], out_i[0]
-        return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
 
-    out_v, out_i = [], []
-    for start in range(0, nq, query_batch):
-        qc = queries[start : start + query_batch]
-        bpad = 0
-        if qc.shape[0] < query_batch and nq > query_batch:
-            bpad = query_batch - qc.shape[0]
-            qc = jnp.pad(qc, ((0, bpad), (0, 0)))
-        v, i = _ivf_search_impl(
+        return _batched_search(run_scan, queries, query_batch)
+
+    def run_probe(qc):
+        return _ivf_search_impl(
             index.centers,
             index.list_data,
             index.list_indices,
@@ -672,13 +664,8 @@ def search(
             metric=index.metric,
             has_filter=filter_bits is not None,
         )
-        if bpad:
-            v, i = v[:-bpad], i[:-bpad]
-        out_v.append(v)
-        out_i.append(i)
-    if len(out_v) == 1:
-        return out_v[0], out_i[0]
-    return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
+
+    return _batched_search(run_probe, queries, query_batch)
 
 
 # -- serialization (neighbors/ivf_flat_serialize.cuh analog) ----------------

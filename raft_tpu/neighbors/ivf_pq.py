@@ -423,7 +423,7 @@ def build(
     # Capacity-capped assignment first (spilled rows encode against their
     # FINAL list's center so ADC distances stay consistent), then encode,
     # then one scatter into the padded layout. See ivf_common.py.
-    cand = ivf_common.topk_labels(ds_f32, centers)
+    cand = ivf_common.topk_labels(ds_f32, centers, k=8)
     max_list = ivf_common.choose_max_list(cand[:, 0], n, n_lists, params.list_cap_factor)
     slot = ivf_common.assign_slots(cand, n_lists=n_lists, max_list=max_list)
     final_labels = (slot // max_list).astype(jnp.int32)
@@ -474,7 +474,7 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     old_ids = flat_ids[keep_order]
     old_l1 = (keep_order // index.max_list).astype(jnp.int32)
 
-    new_cand = ivf_common.topk_labels(vec_f32, index.centers)
+    new_cand = ivf_common.topk_labels(vec_f32, index.centers, k=8)
     all_ids = jnp.concatenate([old_ids, new_ids])
     # old rows never spill past their current list (their codes are
     # residuals against that center): all their candidates are that list
