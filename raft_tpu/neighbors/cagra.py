@@ -508,7 +508,10 @@ def strided_seed_ids(size: int, sample: int) -> jnp.ndarray:
     local and sharded search paths (``dev_seed`` analog,
     ``search_plan.cuh:100``)."""
     s = min(sample, size)
-    return ((jnp.arange(s, dtype=jnp.int64) * size) // s).astype(jnp.int32)
+    # host-side int64: jnp.arange(int64) silently downgrades to int32 when
+    # jax_enable_x64 is off, and i * size overflows int32 at ~2k seeds on
+    # a 1M-row index
+    return jnp.asarray((np.arange(s, dtype=np.int64) * size) // s, jnp.int32)
 
 
 def derive_search_config(params: "CagraSearchParams", k: int, size: int):
@@ -516,7 +519,10 @@ def derive_search_config(params: "CagraSearchParams", k: int, size: int):
     ``search_plan.cuh:136`` adjust step, shared with the sharded path."""
     itopk = max(params.itopk_size, k)
     width = max(1, params.search_width)
-    iters = params.max_iterations or max(10, itopk // max(1, width))
+    # search_plan.cuh:138-144: 1 + min((itopk/width)*1.1, itopk/width + 10),
+    # floored at the reference's min_iterations default
+    ratio = itopk // max(1, width)
+    iters = params.max_iterations or max(10, 1 + min(int(ratio * 1.1), ratio + 10))
     return itopk, width, iters, min(itopk, size)
 
 

@@ -393,12 +393,32 @@ def build(
         order = np.argsort(row_cluster, kind="stable")
         counts = np.bincount(row_cluster, minlength=n_lists)
         budget = max(ksub, min(int(counts.max()) if n_lists else ksub, 4096))
+        n_trunc = int((counts > budget).sum())
+        if n_trunc:
+            from raft_tpu.core.logging import logger
+
+            logger.info(
+                "ivf_pq per-cluster codebooks: %d/%d clusters exceed the %d-row "
+                "training budget; a seeded random subsample of each is used "
+                "(raise kmeans_trainset_fraction's effect via smaller n_lists "
+                "or accept the subsample)",
+                n_trunc,
+                n_lists,
+                budget,
+            )
+        sub_rng = np.random.default_rng(params.seed + 0x5EED)
         Xc = np.zeros((n_lists, budget, pq_len), np.float32)
         Mc = np.zeros((n_lists, budget), np.float32)
         starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
         for c in range(n_lists):
-            take = min(int(counts[c]), budget)
-            rows = flat[order[starts[c] : starts[c] + take]]
+            cnt = int(counts[c])
+            take = min(cnt, budget)
+            sel = order[starts[c] : starts[c] + cnt]
+            if cnt > budget:
+                # unbiased subsample instead of the first rows (which are
+                # ordered by training-set position, not representative)
+                sel = sel[sub_rng.choice(cnt, size=budget, replace=False)]
+            rows = flat[sel]
             Xc[c, :take] = rows
             Mc[c, :take] = 1.0
             if take < ksub and take > 0:  # ensure >= ksub seed rows

@@ -51,7 +51,6 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.core.errors import expects
 from raft_tpu.ops.distance import DistanceType
-from raft_tpu.ops.select_k import select_k
 from raft_tpu.utils.math import cdiv
 
 _SUPPORTED = frozenset(
