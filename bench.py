@@ -106,13 +106,6 @@ def main():
     dt, (v, i) = _timed(lambda: brute_force.search(bf, queries, K, mode="approx"))
     record("brute_force", "approx rt=0.99", dt, i)
 
-    # bf16 storage + bf16 queries: native-MXU matmul at double rate, f32
-    # accumulation (the reference's half-precision brute-force analog)
-    bf16_bf = brute_force.build(dataset.astype(jnp.bfloat16), metric=DistanceType.L2Expanded)
-    q16 = queries.astype(jnp.bfloat16)
-    dt, (v, i) = _timed(lambda: brute_force.search(bf16_bf, q16, K, mode="approx"))
-    record("brute_force", "approx bf16", dt, i)
-
     t0 = time.perf_counter()
     fidx = ivf_flat.build(
         dataset,

@@ -45,6 +45,7 @@ from raft_tpu.cluster.kmeans_balanced import BalancedKMeansParams
 from raft_tpu.core import serialize as ser
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.errors import expects
+from raft_tpu.core.logging import logger
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.neighbors import ivf_common
 from raft_tpu.ops.distance import DistanceType, resolve_metric
@@ -395,13 +396,10 @@ def build(
         budget = max(ksub, min(int(counts.max()) if n_lists else ksub, 4096))
         n_trunc = int((counts > budget).sum())
         if n_trunc:
-            from raft_tpu.core.logging import logger
-
             logger.info(
                 "ivf_pq per-cluster codebooks: %d/%d clusters exceed the %d-row "
                 "training budget; a seeded random subsample of each is used "
-                "(raise kmeans_trainset_fraction's effect via smaller n_lists "
-                "or accept the subsample)",
+                "(lower kmeans_trainset_fraction or raise n_lists to avoid it)",
                 n_trunc,
                 n_lists,
                 budget,

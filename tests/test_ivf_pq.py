@@ -75,7 +75,9 @@ class TestIvfPqSearch:
         _, ref_i = _exact(X, Q, k)
         _, ann_i = ivf_pq.search(index, Q, k, IvfPqSearchParams(n_probes=16))
         recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
-        assert recall >= 0.7, f"recall {recall}"
+        # observed 0.816 (per_subspace) / 0.844 (per_cluster) at this
+        # operating point; floor set one regression-width below
+        assert recall >= 0.78, f"recall {recall}"
 
     def test_recall_with_refine(self, rng):
         n, d, nq, k = 6000, 32, 64, 10
@@ -100,7 +102,7 @@ class TestIvfPqSearch:
         _, ref_i = _exact(X, Q, k, metric=DistanceType.InnerProduct)
         _, ann_i = ivf_pq.search(index, Q, k, IvfPqSearchParams(n_probes=12))
         recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
-        assert recall >= 0.6, f"IP recall {recall}"
+        assert recall >= 0.75, f"IP recall {recall}"
 
     def test_l2sqrt_matches_l2_ranking(self, rng):
         n, d, nq, k = 2000, 16, 16, 5
@@ -129,7 +131,9 @@ class TestIvfPqSearch:
             index, Q, k, IvfPqSearchParams(n_probes=16, lut_dtype=jnp.bfloat16)
         )
         recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
-        assert recall >= 0.6, f"bf16-LUT recall {recall}"
+        # observed 0.775 with the bf16 LUT (vs ~0.82 f32): floor catches a
+        # ranking regression, not LUT-rounding noise
+        assert recall >= 0.72, f"bf16-LUT recall {recall}"
 
     def test_prefilter(self, rng):
         from raft_tpu.core.bitset import Bitset
