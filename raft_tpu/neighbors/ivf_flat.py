@@ -84,10 +84,16 @@ class IvfFlatSearchParams:
     matmul (``"highest"`` = f32-exact passes, ``"default"`` = fast)."""
 
     n_probes: int = 20
-    fused_qt: int = 64
-    fused_probe_factor: int = 4
-    fused_group: int = 1  # lists per DMA block / probe-table entry
-    fused_merge: str = "seg"
+    # qt/probe_factor/group/merge = the measured 1M x 128 operating point
+    # on TPU v5e (see docs/tpu_design.md); group rounds down to a divisor
+    # of n_lists and the probe table caps at the unit count, so they
+    # degrade gracefully on small indexes. precision stays "highest"
+    # (f32-exact distances) by default — the bench trades it for speed
+    # explicitly with "default"
+    fused_qt: int = 128
+    fused_probe_factor: int = 32
+    fused_group: int = 8  # lists per DMA block / probe-table entry
+    fused_merge: str = "seg4"
     fused_precision: str = "highest"
 
 
