@@ -129,11 +129,11 @@ def main():
         )
         record("ivf_flat", f"fused bf16 npr={npr} pf={pf} G={g} {merge}", dt, i)
     sp = ivf_flat.IvfFlatSearchParams(
-        n_probes=20, fused_qt=128, fused_probe_factor=32, fused_group=8,
+        n_probes=20, fused_qt=128, fused_probe_factor=32, fused_group=4,
         fused_merge="seg4", fused_precision="default",
     )
     dt, (v, i) = _timed(lambda: ivf_flat.search(fidx, queries, K, sp, mode="fused"))
-    record("ivf_flat", "fused f32 npr=20 pf=32 G=8 seg4", dt, i)
+    record("ivf_flat", "fused f32 npr=20 pf=32 G=4 seg4", dt, i)
     dt, (v, i) = _timed(lambda: ivf_flat.search(fidx, queries, K, n_probes=20, mode="scan"))
     record("ivf_flat", "scan nprobe=20", dt, i)
 

@@ -605,13 +605,28 @@ def search(
 
         expects(supported_metric(index.metric), "fused mode: unsupported metric")
         rank = index.center_rank
+        legacy_order = rank is None or getattr(index, "_legacy_order", False)
         if rank is None:
             # legacy (pre-v3) index: compute once and cache on the object so
             # serving loops don't pay the host-side PCA walk per call
             rank = jnp.asarray(spatial_center_rank(np.asarray(index.centers)))
             index.center_rank = rank
-        # round the DMA group down to a divisor of n_lists
-        group = max(1, min(params.fused_group, index.n_lists))
+            index._legacy_order = True
+        # Clamp the DMA group to the VMEM budget: two double-buffered list
+        # blocks, plus the in-kernel f32 copy that int8/uint8 lists get
+        # (f32 is identity, bf16 rides the MXU natively). Empirical limit:
+        # 2 x 8 MB f32 blocks overflow the ~16 MB scoped budget, 2 x 4 MB
+        # bf16 blocks fit with room.
+        itemsize = index.list_data.dtype.itemsize
+        cast_bytes = 4 if itemsize < 2 else 0
+        per_group = index.max_list * index.dim * (2 * itemsize + cast_bytes)
+        vmem_group_cap = max(1, (12 * 1024 * 1024) // max(1, per_group))
+        group = max(1, min(params.fused_group, index.n_lists, vmem_group_cap))
+        if legacy_order:
+            # pre-v3 files store lists in arbitrary k-means order; grouping
+            # assumes spatially adjacent lists, so fall back to single-list
+            # DMA blocks rather than silently losing probe coverage
+            group = 1
         while index.n_lists % group:
             group -= 1
 

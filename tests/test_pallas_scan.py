@@ -170,3 +170,30 @@ def test_fused_int8_lists():
     _, bi = brute_force.search(bf, qs, k)
     rec = float(neighborhood_recall(np.asarray(i), np.asarray(bi)))
     assert rec > 0.99, rec
+
+
+def test_fused_legacy_index_without_spatial_order():
+    """Pre-v3 indexes (no center_rank, lists in arbitrary k-means order)
+    must regenerate the rank, fall back to single-list DMA groups, and
+    still return correct results."""
+    import dataclasses
+
+    ds, qs = _data(seed=8)
+    k = 5
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=16, seed=1))
+    legacy = dataclasses.replace(idx, center_rank=None)
+    v, i = ivf_flat.search(
+        legacy,
+        qs,
+        k,
+        ivf_flat.IvfFlatSearchParams(
+            n_probes=16, fused_qt=8, fused_probe_factor=16, fused_group=8, fused_merge="exact"
+        ),
+        mode="fused",
+    )
+    assert legacy.center_rank is not None  # regenerated + cached
+    assert getattr(legacy, "_legacy_order", False)
+    bf = brute_force.build(ds, metric=DistanceType.L2Expanded)
+    _, bi = brute_force.search(bf, qs, k)
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(bi)))
+    assert rec > 0.999, rec
