@@ -85,7 +85,10 @@ class CagraSearchParams:
     + ``num_random_samplings``). On clustered data random inits rarely
     land near the query's cluster and the pruned fixed-degree graph has
     few long-range edges to recover, so sampled seeding is the difference
-    between ~0.2 and ~0.9 recall at 1M scale. 0 = legacy random init."""
+    between ~0.2 and ~0.9 recall at 1M scale. 0 = legacy random init.
+
+    ``seed`` only affects the legacy random init (``init_sample=0``): the
+    default strided-sample path is deterministic and ignores it."""
 
     itopk_size: int = 64
     search_width: int = 1
@@ -561,10 +564,10 @@ def search(
         if qc.shape[0] < query_batch and nq > query_batch:
             bpad = query_batch - qc.shape[0]
             qc = jnp.pad(qc, ((0, bpad), (0, 0)))
-        key, kb = jax.random.split(key)
         if params.init_sample > 0:
             init_ids = strided_seed_ids(index.size, params.init_sample)
         else:
+            key, kb = jax.random.split(key)
             init_ids = jax.random.randint(kb, (qc.shape[0], n_init), 0, index.size, jnp.int32)
         use_vpq = index.dataset is None
         vpq_arrays = None

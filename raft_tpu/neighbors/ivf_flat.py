@@ -80,8 +80,10 @@ class IvfFlatSearchParams:
     The ``fused_*`` knobs tune the Pallas fused scan (``mode="fused"``):
     query-tile height, tile probe-table size (``fused_probe_factor *
     n_probes`` lists per tile), top-k merge strategy (``"seg"``/``"seg1"``/``"seg4"``
-    banked lane-group PartialReduce or ``"exact"``), and MXU precision for the distance
-    matmul (``"highest"`` = f32-exact passes, ``"default"`` = fast)."""
+    banked lane-group PartialReduce, ``"bank"``/``"bankN"`` persistent
+    min-merge buffer with periodic extraction — the fast path — or
+    ``"exact"``), and MXU precision for the distance matmul
+    (``"highest"`` = f32-exact passes, ``"default"`` = fast)."""
 
     n_probes: int = 20
     # qt/probe_factor/group/merge = the measured 1M x 128 operating point
@@ -95,6 +97,10 @@ class IvfFlatSearchParams:
     fused_group: int = 8  # lists per DMA block / probe-table entry
     fused_merge: str = "seg4"
     fused_precision: str = "highest"
+    # bank-merge extras: extraction period (0 = once per tile) and score
+    # column-chunk rows (0 = whole DMA block at once)
+    fused_extract_every: int = 0
+    fused_col_chunk: int = 0
 
 
 @jax.tree_util.register_pytree_node_class
@@ -542,6 +548,49 @@ def _batched_search(run, queries, query_batch: int):
     return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
 
 
+# Rank cache for legacy (pre-v3) indexes, keyed on the identity of the
+# centers array: computing the PCA-bisection rank is a host-side walk we
+# don't want per search call, and caching ON the index object (as an
+# attribute) is a mutation of user-owned state that doesn't survive
+# serialization or pytree transforms. Weak refs let index arrays die.
+_RANK_CACHE: dict = {}
+
+
+def _legacy_rank_cache(centers) -> jax.Array:
+    import weakref
+
+    key = id(centers)
+    hit = _RANK_CACHE.get(key)
+    if hit is not None and hit[0]() is centers:
+        return hit[1]
+    from raft_tpu.ops.pallas.ivf_scan import spatial_center_rank
+
+    rank = jnp.asarray(spatial_center_rank(np.asarray(centers)))
+    try:
+        ref = weakref.ref(centers, lambda _: _RANK_CACHE.pop(key, None))
+    except TypeError:  # some array types refuse weakrefs; cache without eviction
+        ref = lambda: centers  # noqa: E731
+    _RANK_CACHE[key] = (ref, rank)
+    return rank
+
+
+def _rank_is_identity(rank) -> bool:
+    key = id(rank)
+    hit = _RANK_CACHE.get(("ident", key))
+    if hit is not None and hit[0]() is rank:
+        return hit[1]
+    import weakref
+
+    r = np.asarray(rank)
+    ident = bool((r == np.arange(r.shape[0], dtype=r.dtype)).all())
+    try:
+        ref = weakref.ref(rank, lambda _: _RANK_CACHE.pop(("ident", key), None))
+    except TypeError:
+        ref = lambda: rank  # noqa: E731
+    _RANK_CACHE[("ident", key)] = (ref, ident)
+    return ident
+
+
 def search(
     index: IvfFlatIndex,
     queries,
@@ -605,13 +654,18 @@ def search(
 
         expects(supported_metric(index.metric), "fused mode: unsupported metric")
         rank = index.center_rank
-        legacy_order = rank is None or getattr(index, "_legacy_order", False)
         if rank is None:
-            # legacy (pre-v3) index: compute once and cache on the object so
-            # serving loops don't pay the host-side PCA walk per call
-            rank = jnp.asarray(spatial_center_rank(np.asarray(index.centers)))
-            index.center_rank = rank
-            index._legacy_order = True
+            # legacy (pre-v3) index: compute once and cache OUTSIDE the
+            # index (keyed on the centers array) — mutating a user-owned
+            # index here would leak an unserializable side channel
+            rank = _legacy_rank_cache(index.centers)
+        # Lists are physically stored in spatial order only when the v3
+        # build produced them: that build reorders list storage and leaves
+        # center_rank == identity. A rank regenerated for a legacy file is
+        # a genuine PCA-bisection permutation (never identity), so this
+        # check is derived from the data — it survives serialization and
+        # pytree round-trips, unlike an in-memory flag.
+        legacy_order = not _rank_is_identity(rank)
         # Clamp the DMA group to the VMEM budget: two double-buffered list
         # blocks, plus the in-kernel f32 copy that int8/uint8 lists get
         # (f32 is identity, bf16 rides the MXU natively). Empirical limit:
@@ -648,6 +702,8 @@ def search(
                 has_filter=filter_bits is not None,
                 merge=params.fused_merge,
                 precision=params.fused_precision,
+                extract_every=params.fused_extract_every,
+                col_chunk=params.fused_col_chunk,
                 interpret=jax.default_backend() != "tpu",
             )
 

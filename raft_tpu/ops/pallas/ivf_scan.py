@@ -33,6 +33,25 @@ work savings with three pieces:
    ``lax.approx_max_k``; ``seg`` = 2 banks, more banks = fewer
    same-lane collisions between candidates, slightly wider extract).
 
+   ``merge="bank"``/``"bankN"`` goes one step further: the per-step
+   compressed candidates are **min-merged elementwise** into a persistent
+   ``[qt, N*128]`` (value, slot) buffer — 3 VPU selects per step — and the
+   k-round extraction runs only every ``extract_every`` steps (0 = once at
+   the end). The per-step cost drops from "compress + concat + k
+   min-extract rounds" (the round-3 bottleneck: ~3-4x the matmul time) to
+   "compress + 3 selects". The price is cross-step lane collisions: two
+   candidates from different probe steps sharing a (lane, bank) slot keep
+   only the better one. With N*128 slots and the true top-k spread
+   uniformly over lanes, the expected loss is ~C(k,2)/(N*128) of one
+   candidate per query (<0.5% recall@10 at N=8); ``extract_every`` bounds
+   the collision window when that matters.
+
+4. **Column-chunked scoring** (``col_chunk``): the [qt, m] score tile is
+   computed in column slices so the f32 intermediate stays small enough to
+   raise ``qt`` (bigger query tiles amortize the per-tile DMA of shared
+   lists). Only supported with bank merge (slices merge into the
+   persistent buffer; no per-slice extraction needed).
+
 The kernel supports L2Expanded / L2SqrtExpanded / InnerProduct /
 CosineExpanded, prefilters (folded into ``list_indices`` outside), and runs
 in interpret mode on CPU for tests.
@@ -165,8 +184,62 @@ def _seg_compress(score, base, qt: int, m: int, banks: int):
     return jnp.concatenate(out_v, axis=1), jnp.concatenate(out_s, axis=1)
 
 
-def _make_kernel(*, k, metric, merge, qt, m, n_steps, precision):
-    def kernel(pr_ref, pv_ref, q_ref, ld_ref, ln_ref, li_ref, outv_ref, outi_ref, accv, acci):
+def _bank_count(merge: str) -> int:
+    import re
+
+    m = re.search(r"(\d+)$", merge)
+    n = int(m.group(1)) if m else 0
+    if merge.startswith("bank"):
+        return n or 4
+    if merge.startswith("seg"):
+        return n or 2
+    return 0
+
+
+def _eff_banks(merge: str, m: int, col_chunk: int) -> int:
+    """Banks clamped to the lane-group count of one compress call (a block
+    slice narrower than banks*128 fills fewer banks)."""
+    mc = col_chunk if col_chunk else m
+    return max(1, min(_bank_count(merge), cdiv(mc, 128)))
+
+
+def _make_kernel(*, k, metric, merge, qt, m, n_steps, precision, extract_every, col_chunk):
+    bank_mode = merge.startswith("bank")
+    banks = _eff_banks(merge, m, col_chunk) if bank_mode else _bank_count(merge)
+    mc = col_chunk if (bank_mode and col_chunk) else m
+    n_cc = m // mc
+
+    def score_slice(q, ld_ref, ln_ref, li_ref, lo: int):
+        """One [qt, mc] score slice: matmul + prepared epilogue."""
+        y = ld_ref[0, lo : lo + mc, :]
+        if y.dtype == jnp.bfloat16:
+            # bf16 lists ride the native bf16 MXU path with f32 accum
+            q = q.astype(jnp.bfloat16)
+        else:
+            y = y.astype(jnp.float32)  # int8 lists cast per block
+        dot = lax.dot_general(
+            q,
+            y,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )  # [qt, mc]
+        # ln_ref carries the PREPARED epilogue term (see the wrapper):
+        # L2 -> norms with +inf folded in for invalid slots, IP -> a
+        # 0/+inf penalty, cosine -> precomputed rsqrt norm scales — so
+        # validity and normalization cost no extra [qt, m] passes
+        ln = ln_ref[0, 0, lo : lo + mc]
+        if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+            return ln[None, :] - 2.0 * dot
+        if metric == DistanceType.InnerProduct:
+            return ln[None, :] - dot
+        # CosineExpanded; queries pre-normalized by the wrapper
+        return jnp.where(
+            (li_ref[0, 0, lo : lo + mc] >= 0)[None, :], -dot * ln[None, :], jnp.inf
+        )
+
+    def kernel(pr_ref, pv_ref, q_ref, ld_ref, ln_ref, li_ref, outv_ref, outi_ref,
+               accv, acci, bankv=None, banki=None):
         i = pl.program_id(0)
         j = pl.program_id(1)
 
@@ -174,50 +247,62 @@ def _make_kernel(*, k, metric, merge, qt, m, n_steps, precision):
         def _():
             accv[...] = jnp.full((qt, k), jnp.inf, jnp.float32)
             acci[...] = jnp.full((qt, k), -1, jnp.int32)
+            if bank_mode:
+                bankv[...] = jnp.full((qt, banks * 128), jnp.inf, jnp.float32)
+                banki[...] = jnp.full((qt, banks * 128), -1, jnp.int32)
 
         @pl.when(pv_ref[i, j] > 0)
         def _():
             q = q_ref[...]
-            y = ld_ref[0]
-            if y.dtype == jnp.bfloat16:
-                # bf16 lists ride the native bf16 MXU path with f32 accum
-                q = q.astype(jnp.bfloat16)
-            else:
-                y = y.astype(jnp.float32)  # int8 lists cast per block
-            dot = lax.dot_general(
-                q,
-                y,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=precision,
-            )  # [qt, m]
-            # ln_ref carries the PREPARED epilogue term (see the wrapper):
-            # L2 -> norms with +inf folded in for invalid slots, IP -> a
-            # 0/+inf penalty, cosine -> precomputed rsqrt norm scales — so
-            # validity and normalization cost no extra [qt, m] passes
-            ln = ln_ref[0, 0]
-            if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
-                score = ln[None, :] - 2.0 * dot
-            elif metric == DistanceType.InnerProduct:
-                score = ln[None, :] - dot
-            else:  # CosineExpanded; queries pre-normalized by the wrapper
-                score = jnp.where(
-                    (li_ref[0, 0] >= 0)[None, :], -dot * ln[None, :], jnp.inf
-                )
             base = pr_ref[i, j] * m
-            if merge.startswith("seg"):
-                banks = int(merge[3:]) if len(merge) > 3 else 2
-                score, slot = _seg_compress(score, base, qt, m, banks)
+            if bank_mode:
+                # compress each column slice, min-merge into the bank buffer
+                for cc in range(n_cc):
+                    score = score_slice(q, ld_ref, ln_ref, li_ref, cc * mc)
+                    if merge.startswith("bankraw"):  # perf probe: no compress
+                        bankv[...] = score[:, : banks * 128]
+                        banki[...] = jnp.full((qt, banks * 128), 1, jnp.int32)
+                        continue
+                    v, s = _seg_compress(score, base + cc * mc, qt, mc, banks)
+                    if merge.startswith("bankover"):  # perf probe: no min-merge
+                        bankv[...] = v
+                        banki[...] = s
+                    else:
+                        take = v < bankv[...]
+                        bankv[...] = jnp.where(take, v, bankv[...])
+                        banki[...] = jnp.where(take, s, banki[...])
             else:
-                valid = jnp.isfinite(score)
-                slot = jnp.where(
-                    valid, base + lax.broadcasted_iota(jnp.int32, (qt, m), 1), -1
-                )
-            cv = jnp.concatenate([accv[...], score], axis=1)
-            ci = jnp.concatenate([acci[...], slot], axis=1)
-            nv, ni = _extract_topk(cv, ci, k)
-            accv[...] = nv
-            acci[...] = ni
+                score = score_slice(q, ld_ref, ln_ref, li_ref, 0)
+                if merge.startswith("seg"):
+                    score, slot = _seg_compress(score, base, qt, m, banks)
+                else:
+                    valid = jnp.isfinite(score)
+                    slot = jnp.where(
+                        valid, base + lax.broadcasted_iota(jnp.int32, (qt, m), 1), -1
+                    )
+                cv = jnp.concatenate([accv[...], score], axis=1)
+                ci = jnp.concatenate([acci[...], slot], axis=1)
+                nv, ni = _extract_topk(cv, ci, k)
+                accv[...] = nv
+                acci[...] = ni
+
+        if bank_mode:
+            # periodic + final extraction of the bank buffer into the top-k
+            # accumulator; resetting bounds the cross-step collision window
+            if extract_every and extract_every < n_steps:
+                do_extract = ((j + 1) % extract_every == 0) | (j == n_steps - 1)
+            else:
+                do_extract = j == n_steps - 1
+
+            @pl.when(do_extract)
+            def _():
+                cv = jnp.concatenate([accv[...], bankv[...]], axis=1)
+                ci = jnp.concatenate([acci[...], banki[...]], axis=1)
+                nv, ni = _extract_topk(cv, ci, k)
+                accv[...] = nv
+                acci[...] = ni
+                bankv[...] = jnp.full((qt, banks * 128), jnp.inf, jnp.float32)
+                banki[...] = jnp.full((qt, banks * 128), -1, jnp.int32)
 
         @pl.when(j == n_steps - 1)
         def _():
@@ -228,7 +313,10 @@ def _make_kernel(*, k, metric, merge, qt, m, n_steps, precision):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "metric", "qt", "merge", "precision", "interpret")
+    jax.jit,
+    static_argnames=(
+        "k", "metric", "qt", "merge", "precision", "extract_every", "col_chunk", "interpret"
+    ),
 )
 def fused_list_topk(
     list_data,
@@ -243,6 +331,8 @@ def fused_list_topk(
     qt: int,
     merge: str = "seg",
     precision: str = "highest",
+    extract_every: int = 0,
+    col_chunk: int = 0,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run the fused probed-list scan.
@@ -256,14 +346,28 @@ def fused_list_topk(
     nq_pad = queries_sorted.shape[0]
     n_qt, n_steps = tile_probes.shape
     assert nq_pad == n_qt * qt
+    if col_chunk:
+        expects(merge.startswith("bank"), "col_chunk requires bank merge")
+        expects(m % col_chunk == 0, "col_chunk %d must divide block rows %d", col_chunk, m)
 
     prec = dict(
         highest=lax.Precision.HIGHEST,
         default=lax.Precision.DEFAULT,
     )[precision]
     kernel = _make_kernel(
-        k=k, metric=metric, merge=merge, qt=qt, m=m, n_steps=n_steps, precision=prec
+        k=k, metric=metric, merge=merge, qt=qt, m=m, n_steps=n_steps, precision=prec,
+        extract_every=extract_every, col_chunk=col_chunk,
     )
+    scratch_shapes = [
+        pltpu.VMEM((qt, k), jnp.float32),
+        pltpu.VMEM((qt, k), jnp.int32),
+    ]
+    if merge.startswith("bank"):
+        w = _eff_banks(merge, m, col_chunk) * 128
+        scratch_shapes += [
+            pltpu.VMEM((qt, w), jnp.float32),
+            pltpu.VMEM((qt, w), jnp.int32),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_qt, n_steps),
@@ -277,10 +381,7 @@ def fused_list_topk(
             pl.BlockSpec((qt, k), lambda i, j, pr, pv: (i, 0)),
             pl.BlockSpec((qt, k), lambda i, j, pr, pv: (i, 0)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((qt, k), jnp.float32),
-            pltpu.VMEM((qt, k), jnp.int32),
-        ],
+        scratch_shapes=scratch_shapes,
     )
     # prepare the per-slot epilogue term the kernel folds into the matmul
     # output (one pass here instead of one per (tile, probe) step inside):
@@ -314,6 +415,53 @@ def fused_list_topk(
 
 
 # ---------------------------------------------------------------------------
+# shared probe-table construction (used by the IVF-Flat and IVF-PQ wrappers)
+# ---------------------------------------------------------------------------
+
+
+def build_tile_probe_tables(
+    coarse, probed, center_rank, *, nq: int, qt: int, n_lists: int,
+    group: int, n_probes: int, probe_factor: int
+):
+    """Tile-coherent query ordering + per-tile union probe tables.
+
+    ``coarse [nq, n_lists]`` coarse scores (smaller = closer),
+    ``probed [nq, n_lists]`` bool. Returns ``(order_pad [nq_pad],
+    tile_probes [n_qt, P], probe_valid [n_qt, P])`` where probe units are
+    ``group`` adjacent lists and invalid slots re-address the row's last
+    valid unit (DMA-friendly ascending order)."""
+    top1 = jnp.argmin(coarse, axis=1)
+    order = jnp.argsort(center_rank[top1], stable=True).astype(jnp.int32)
+
+    n_qt = cdiv(nq, qt)
+    nq_pad = n_qt * qt
+    order_pad = jnp.concatenate(
+        [order, jnp.broadcast_to(order[:1], (nq_pad - nq,))]
+    ) if nq_pad != nq else order
+    row_real = (jnp.arange(nq_pad) < nq)[:, None]
+    probed_sorted = probed[order_pad] & row_real
+
+    expects(n_lists % group == 0, "n_lists %d not divisible by group %d", n_lists, group)
+    n_units = n_lists // group
+    probed_u = probed_sorted.reshape(nq_pad, n_units, group).any(axis=2)
+    p = min(n_units, max(cdiv(probe_factor * n_probes, group), cdiv(n_probes, group)))
+    counts = jnp.sum(probed_u.reshape(n_qt, qt, n_units).astype(jnp.int32), axis=1)
+    cvals, tile_probes = lax.top_k(counts, p)
+    probe_valid = (cvals > 0).astype(jnp.int32)
+    # Ascending probe order per tile: the DMA engine pipelines far better
+    # over monotonically increasing block indices (measured ~30% on v5e).
+    # Invalid slots get the row's last valid id so their (skipped) steps
+    # re-address an already-resident block instead of fetching a new one.
+    sort_key = jnp.where(probe_valid > 0, tile_probes, n_units)
+    probe_order = jnp.argsort(sort_key, axis=1)
+    tile_probes = jnp.take_along_axis(tile_probes, probe_order, axis=1)
+    probe_valid = jnp.take_along_axis(probe_valid, probe_order, axis=1)
+    last_valid = jnp.max(jnp.where(probe_valid > 0, tile_probes, 0), axis=1, keepdims=True)
+    tile_probes = jnp.where(probe_valid > 0, tile_probes, last_valid).astype(jnp.int32)
+    return order_pad, tile_probes, probe_valid
+
+
+# ---------------------------------------------------------------------------
 # full search wrapper
 # ---------------------------------------------------------------------------
 
@@ -330,6 +478,8 @@ def fused_list_topk(
         "has_filter",
         "merge",
         "precision",
+        "extract_every",
+        "col_chunk",
         "interpret",
     ),
 )
@@ -351,6 +501,8 @@ def ivf_flat_fused_search(
     has_filter: bool = False,
     merge: str = "seg",
     precision: str = "highest",
+    extract_every: int = 0,
+    col_chunk: int = 0,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """IVF-Flat search through the Pallas fused scan. Same candidate-set
@@ -375,37 +527,12 @@ def ivf_flat_fused_search(
     from raft_tpu.neighbors.ivf_common import probe_selection
 
     coarse, probed = probe_selection(centers, qf, n_probes, metric)
-
-    top1 = jnp.argmin(coarse, axis=1)
-    order = jnp.argsort(center_rank[top1], stable=True).astype(jnp.int32)
-
-    n_qt = cdiv(nq, qt)
-    nq_pad = n_qt * qt
-    order_pad = jnp.concatenate(
-        [order, jnp.broadcast_to(order[:1], (nq_pad - nq,))]
-    ) if nq_pad != nq else order
+    order_pad, tile_probes, probe_valid = build_tile_probe_tables(
+        coarse, probed, center_rank, nq=nq, qt=qt, n_lists=n_lists,
+        group=group, n_probes=n_probes, probe_factor=probe_factor,
+    )
+    nq_pad = order_pad.shape[0]
     qs = qf[order_pad]
-    row_real = (jnp.arange(nq_pad) < nq)[:, None]
-    probed_sorted = probed[order_pad] & row_real
-
-    # ---- tile-union probe table (group-granular) -------------------------
-    expects(n_lists % group == 0, "n_lists %d not divisible by group %d", n_lists, group)
-    n_units = n_lists // group
-    probed_u = probed_sorted.reshape(nq_pad, n_units, group).any(axis=2)
-    p = min(n_units, max(cdiv(probe_factor * n_probes, group), cdiv(n_probes, group)))
-    counts = jnp.sum(probed_u.reshape(n_qt, qt, n_units).astype(jnp.int32), axis=1)
-    cvals, tile_probes = lax.top_k(counts, p)
-    probe_valid = (cvals > 0).astype(jnp.int32)
-    # Ascending probe order per tile: the DMA engine pipelines far better
-    # over monotonically increasing block indices (measured ~30% on v5e).
-    # Invalid slots get the row's last valid id so their (skipped) steps
-    # re-address an already-resident block instead of fetching a new one.
-    sort_key = jnp.where(probe_valid > 0, tile_probes, n_units)
-    probe_order = jnp.argsort(sort_key, axis=1)
-    tile_probes = jnp.take_along_axis(tile_probes, probe_order, axis=1)
-    probe_valid = jnp.take_along_axis(probe_valid, probe_order, axis=1)
-    last_valid = jnp.max(jnp.where(probe_valid > 0, tile_probes, 0), axis=1, keepdims=True)
-    tile_probes = jnp.where(probe_valid > 0, tile_probes, last_valid).astype(jnp.int32)
 
     # ---- prefilter folds into the per-slot validity ----------------------
     li_eff = list_indices
@@ -417,7 +544,14 @@ def ivf_flat_fused_search(
 
     # The DMA/scoring unit is `group` adjacent lists: reshaping keeps the
     # flat slot order, so slots map straight back to list_indices.
+    n_units = n_lists // group
     gm = group * m
+    if col_chunk:
+        # round down to a divisor of the block rows (0 disables chunking)
+        cc = min(col_chunk, gm)
+        while gm % cc:
+            cc -= 1
+        col_chunk = 0 if cc >= gm else cc
     vals, slots = fused_list_topk(
         list_data.reshape(n_units, gm, d),
         list_norms.reshape(n_units, gm) if list_norms is not None else None,
@@ -430,6 +564,8 @@ def ivf_flat_fused_search(
         qt=qt,
         merge=merge,
         precision=precision,
+        extract_every=extract_every,
+        col_chunk=col_chunk,
         interpret=interpret,
     )
 
@@ -449,6 +585,7 @@ def ivf_flat_fused_search(
         out = jnp.where(idx >= 0, out, jnp.inf)
 
     # ---- unsort ----------------------------------------------------------
+    order = order_pad[:nq]
     dist = jnp.zeros((nq, k), jnp.float32).at[order].set(out[:nq])
     ind = jnp.full((nq, k), -1, jnp.int32).at[order].set(idx[:nq])
     return dist, ind

@@ -175,13 +175,25 @@ def test_fused_int8_lists():
 def test_fused_legacy_index_without_spatial_order():
     """Pre-v3 indexes (no center_rank, lists in arbitrary k-means order)
     must regenerate the rank, fall back to single-list DMA groups, and
-    still return correct results."""
+    still return correct results. The legacy layout is simulated with a
+    REAL permutation of the lists (a v3 build already stores lists in
+    spatial order, so merely dropping center_rank would not exercise the
+    grouping-vs-order interaction)."""
     import dataclasses
 
     ds, qs = _data(seed=8)
     k = 5
     idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=16, seed=1))
-    legacy = dataclasses.replace(idx, center_rank=None)
+    perm = np.random.default_rng(3).permutation(idx.n_lists)
+    legacy = dataclasses.replace(
+        idx,
+        centers=idx.centers[perm],
+        list_data=idx.list_data[perm],
+        list_indices=idx.list_indices[perm],
+        list_sizes=idx.list_sizes[perm],
+        list_norms=idx.list_norms[perm] if idx.list_norms is not None else None,
+        center_rank=None,
+    )
     v, i = ivf_flat.search(
         legacy,
         qs,
@@ -191,9 +203,26 @@ def test_fused_legacy_index_without_spatial_order():
         ),
         mode="fused",
     )
-    assert legacy.center_rank is not None  # regenerated + cached
-    assert getattr(legacy, "_legacy_order", False)
+    # the index object itself is never mutated (rank lives in a side cache)
+    assert legacy.center_rank is None
     bf = brute_force.build(ds, metric=DistanceType.L2Expanded)
     _, bi = brute_force.search(bf, qs, k)
     rec = float(neighborhood_recall(np.asarray(i), np.asarray(bi)))
     assert rec > 0.999, rec
+
+
+def test_fused_legacy_rank_not_identity_forces_group1():
+    """A regenerated legacy rank must not read as 'spatial order': grouping
+    falls back to 1 so probe tables never group storage-adjacent lists that
+    are not spatially adjacent."""
+    from raft_tpu.neighbors.ivf_flat import _legacy_rank_cache, _rank_is_identity
+
+    ds, _ = _data(seed=9)
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=16, seed=1))
+    # v3 build: identity rank -> spatial order derived True
+    assert _rank_is_identity(idx.center_rank)
+    perm = np.random.default_rng(4).permutation(idx.n_lists)
+    rank = _legacy_rank_cache(idx.centers[perm])
+    assert not _rank_is_identity(rank)
+    # cache hit returns the same array
+    assert _legacy_rank_cache(idx.centers) is _legacy_rank_cache(idx.centers)
