@@ -26,6 +26,20 @@ TPU-first redesign:
   shared-memory LUT kernel.
 * fp8 LUTs (``detail/ivf_pq_fp_8bit.cuh``) are replaced by an optional
   bf16 LUT mode — the TPU-native reduced-precision path.
+* **Fused Pallas search** (``mode="fused"``, round 4): scalar-prefetch DMA
+  of only the probed code blocks + an in-kernel multi-hot-matmul LUT
+  apply — the work-proportional fast path mirroring the reference's
+  ``compute_similarity`` kernel. See :mod:`raft_tpu.ops.pallas.pq_scan`.
+  It needs ``ksub <= 64`` (pq_bits <= 6), OR ``pq_kind="nibble"``:
+  **additive nibble codebooks** — each subspace quantized by the SUM of
+  two 16-entry codebooks (A[hi] + B[lo], one byte per code) — 256
+  effective centers at 32-column LUT cost, the TPU-native answer to the
+  reference's fp8-LUT trick (2-level residual quantization instead of
+  low-precision table entries).
+* ``pq_bits=4`` codes are **bit-packed** two per byte
+  (``ivf_pq_types.hpp:129-164`` / ``detail/ivf_pq_codepacking.cuh``
+  analog — pairwise packing, not 16-byte interleave: TPU DMA wants plain
+  contiguous bytes), halving code storage and scan DMA.
 
 Supported metrics: L2Expanded, L2SqrtExpanded, InnerProduct.
 """
@@ -95,14 +109,27 @@ class IvfPqIndexParams:
     # second-nearest center, which measurably degrades code quality —
     # unlike IVF-Flat, where spill only affects which probe finds the row.
     list_cap_factor: float = 0.0
+    # "kmeans" = one 2^pq_bits-center codebook per subspace (reference
+    # semantics). "nibble" = additive nibble pairs (requires pq_bits=8,
+    # per_subspace): subspace j is quantized by A[j][hi] + B[j][lo] — 256
+    # effective centers whose fused-scan LUT costs only 32 columns.
+    pq_kind: str = "kmeans"
 
 
 @dataclasses.dataclass
 class IvfPqSearchParams:
-    """``ivf_pq::search_params`` analog (``ivf_pq_types.hpp:120``)."""
+    """``ivf_pq::search_params`` analog (``ivf_pq_types.hpp:120``).
+
+    The ``fused_*`` knobs tune the Pallas fused scan (``mode="fused"``);
+    they mirror :class:`raft_tpu.neighbors.ivf_flat.IvfFlatSearchParams`."""
 
     n_probes: int = 20
     lut_dtype: jnp.dtype = jnp.float32  # bf16 = reduced-precision LUT mode
+    fused_qt: int = 128
+    fused_probe_factor: int = 32
+    fused_group: int = 8
+    fused_merge: str = "bank8"
+    fused_extract_every: int = 0
 
 
 @jax.tree_util.register_pytree_node_class
@@ -115,7 +142,11 @@ class IvfPqIndex:
     rotation: jax.Array  # [rot_dim, d] f32 orthonormal transform
     pq_centers: jax.Array  # per_subspace: [pq_dim, ksub, pq_len]
     #                         per_cluster:  [n_lists, ksub, pq_len]
-    codes: jax.Array  # [n_lists, max_list, pq_dim] uint8
+    #   For additive nibble codebooks, the MATERIALIZED 256-entry sum grid
+    #   pq_centers[j, hi*16+lo] = A[j, hi] + B[j, lo] — every XLA path
+    #   (scan/probe/sqnorms/encode) works on it unchanged; the fused
+    #   kernel re-derives A/B via nibble_books().
+    codes: jax.Array  # [n_lists, max_list, pq_dim] uint8 (pq_dim/2 when packed)
     list_indices: jax.Array  # [n_lists, max_list] i32, -1 = empty
     list_sizes: jax.Array  # [n_lists] i32
     rot_sqnorms: jax.Array  # [n_lists, max_list] f32 ||c_rot + resid||^2
@@ -124,6 +155,9 @@ class IvfPqIndex:
     pq_bits: int
     size: int
     list_cap_factor: float = 0.0  # build-time cap; honored by extend()
+    additive: bool = False  # nibble-pair codebooks (pq_kind="nibble")
+    packed: bool = False  # 4-bit codes packed two per byte
+    center_rank: Optional[jax.Array] = None  # [n_lists] spatial rank (v3+)
 
     def tree_flatten(self):
         return (
@@ -136,19 +170,26 @@ class IvfPqIndex:
                 self.list_indices,
                 self.list_sizes,
                 self.rot_sqnorms,
+                self.center_rank,
             ),
-            (self.metric, self.codebook_kind, self.pq_bits, self.size, self.list_cap_factor),
+            (
+                self.metric, self.codebook_kind, self.pq_bits, self.size,
+                self.list_cap_factor, self.additive, self.packed,
+            ),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(
-            *children,
+            *children[:8],
             metric=aux[0],
             codebook_kind=aux[1],
             pq_bits=aux[2],
             size=aux[3],
             list_cap_factor=aux[4],
+            additive=aux[5],
+            packed=aux[6],
+            center_rank=children[8],
         )
 
     @property
@@ -165,7 +206,7 @@ class IvfPqIndex:
 
     @property
     def pq_dim(self) -> int:
-        return self.codes.shape[2]
+        return self.codes.shape[2] * 2 if self.packed else self.codes.shape[2]
 
     @property
     def pq_len(self) -> int:
@@ -178,6 +219,10 @@ class IvfPqIndex:
     @property
     def max_list(self) -> int:
         return self.codes.shape[1]
+
+    def codes_unpacked(self) -> jax.Array:
+        """[n_lists, max_list, pq_dim] u8 view for the XLA decode paths."""
+        return unpack_codes(self.codes) if self.packed else self.codes
 
 
 # ---------------------------------------------------------------------------
@@ -249,6 +294,90 @@ def _rotated_residuals(X, labels, centers, rotation, pq_dim: int):
     resid = X - centers[labels]
     rr = resid @ rotation.T  # [n, rot_dim]
     return rr.reshape(X.shape[0], pq_dim, -1)
+
+
+def pack_codes(codes) -> jax.Array:
+    """Pack 4-bit codes pairwise: byte b = code[2b] | (code[2b+1] << 4).
+    (``detail/ivf_pq_codepacking.cuh`` analog; contiguous pairs instead of
+    the reference's 16-byte interleave — TPU DMA wants plain bytes.)"""
+    lo = codes[..., 0::2]
+    hi = codes[..., 1::2]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_codes(packed) -> jax.Array:
+    """Inverse of :func:`pack_codes`: [..., bpr] u8 -> [..., 2*bpr] u8."""
+    lo = packed & jnp.uint8(15)
+    hi = packed >> 4
+    return jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], -1)
+
+
+def nibble_books(pq_centers) -> jax.Array:
+    """Derive the fused-scan nibble codebooks [pq_dim, 32, pq_len] from the
+    materialized additive grid ``pq_centers[j, hi*16+lo] = A[hi] + B[lo]``:
+    A'[hi] = grid[hi*16], B'[lo] = grid[lo] - grid[0] reproduces every sum
+    exactly (A' absorbs B[0])."""
+    pq_dim, ksub, pq_len = pq_centers.shape
+    a = pq_centers[:, 0::16, :]  # [pq_dim, 16, pq_len] = A + B[0]
+    b = pq_centers[:, 0:16, :] - pq_centers[:, 0:1, :]  # B - B[0]
+    return jnp.concatenate([a, b], axis=1)  # hi-half then lo-half
+
+
+def _train_nibble_books(t_resid, key, n_iters: int):
+    """Additive nibble codebooks: A = 16-center Lloyd on the residuals,
+    B = 16-center Lloyd on the second-level residuals, then alternating
+    joint re-encode / re-fit. Returns the materialized 256-entry sum grid
+    [pq_dim, 256, pq_len] (every non-fused path consumes that directly).
+
+    A 2-level per-subspace residual quantizer: same decode cost as
+    pq_bits=4 but 256 effective centers — the accuracy/FLOP point the
+    reference reaches with fp8 LUTs (``detail/ivf_pq_fp_8bit.cuh``)."""
+    pq_dim = t_resid.shape[1]
+    nt = t_resid.shape[0]
+    Xs = jnp.transpose(t_resid, (1, 0, 2))  # [pq_dim, nt, pq_len]
+    mask = jnp.ones((pq_dim, nt), jnp.float32)
+    ka, kb = jax.random.split(key)
+
+    def seed_init(k, X):
+        idx = jax.random.permutation(k, nt)[: min(16, nt)]
+        init = X[:, idx, :]
+        if init.shape[1] < 16:
+            reps = -(-16 // init.shape[1])
+            init = jnp.tile(init, (1, reps, 1))[:, :16, :]
+        return init
+
+    A = _batched_lloyd(Xs, mask, seed_init(ka, Xs), k=16, n_iters=n_iters)
+
+    def assign(X, books):  # [pq_dim, nt, pq_len] x [pq_dim, 16, pq_len]
+        d2 = (
+            jnp.sum(books * books, axis=-1)[:, None, :]
+            - 2.0 * jnp.einsum("pnl,pkl->pnk", X, books, preferred_element_type=jnp.float32)
+        )
+        return jnp.argmin(d2, axis=-1)  # [pq_dim, nt]
+
+    hi = assign(Xs, A)
+    R2 = Xs - jnp.take_along_axis(A, hi[:, :, None], axis=1)
+    B = _batched_lloyd(R2, mask, seed_init(kb, R2), k=16, n_iters=n_iters)
+
+    def refit(X, labels, k):
+        def one(Xb, lb):
+            sums = jax.ops.segment_sum(Xb, lb, num_segments=k)
+            counts = jax.ops.segment_sum(jnp.ones_like(lb, jnp.float32), lb, num_segments=k)
+            return sums / jnp.maximum(counts[:, None], 1e-9), counts
+
+        return jax.vmap(one)(X, labels)
+
+    for _ in range(2):  # coordinate descent on (A, B)
+        lo = assign(Xs - jnp.take_along_axis(A, hi[:, :, None], axis=1), B)
+        Anew, ca = refit(Xs - jnp.take_along_axis(B, lo[:, :, None], axis=1), hi, 16)
+        A = jnp.where(ca[:, :, None] > 0, Anew, A)
+        hi = assign(Xs - jnp.take_along_axis(B, lo[:, :, None], axis=1), A)
+        Bnew, cb = refit(Xs - jnp.take_along_axis(A, hi[:, :, None], axis=1), lo, 16)
+        B = jnp.where(cb[:, :, None] > 0, Bnew, B)
+
+    # materialize the sum grid: grid[j, hi*16+lo] = A[j,hi] + B[j,lo]
+    grid = A[:, :, None, :] + B[:, None, :, :]  # [pq_dim, 16, 16, pq_len]
+    return grid.reshape(pq_dim, 256, -1)
 
 
 @functools.partial(jax.jit, static_argnames=("per_cluster", "chunk_lists"))
@@ -332,8 +461,15 @@ def build(
         params = IvfPqIndexParams(**kwargs)
     metric = resolve_metric(params.metric)
     expects(metric in _SUPPORTED, "IVF-PQ does not support metric %s", metric)
-    expects(4 <= params.pq_bits <= 8, "pq_bits must be in [4, 8], got %d", params.pq_bits)
+    expects(3 <= params.pq_bits <= 8, "pq_bits must be in [3, 8], got %d", params.pq_bits)
     expects(params.codebook_kind in (PER_SUBSPACE, PER_CLUSTER), "bad codebook_kind")
+    expects(params.pq_kind in ("kmeans", "nibble"), "pq_kind must be kmeans|nibble")
+    nibble = params.pq_kind == "nibble"
+    if nibble:
+        expects(
+            params.pq_bits == 8 and params.codebook_kind == PER_SUBSPACE,
+            "pq_kind='nibble' requires pq_bits=8 and per_subspace codebooks",
+        )
     dataset = jnp.asarray(dataset)
     expects(dataset.ndim == 2, "dataset must be [n_rows, dim]")
     n, d = dataset.shape
@@ -364,6 +500,15 @@ def build(
             seed=params.seed,
         ),
     )
+    # Physically order the lists by the PCA-bisection spatial rank of
+    # their centers (same as IVF-Flat v3): the fused Pallas scan's
+    # probe-coherent query tiles and group-granular DMA both assume
+    # spatially nearby lists sit next to each other.
+    from raft_tpu.ops.pallas.ivf_scan import spatial_center_rank
+
+    srank = spatial_center_rank(np.asarray(centers))
+    centers = jnp.asarray(np.asarray(centers)[np.argsort(srank)])
+    center_rank = jnp.arange(n_lists, dtype=jnp.int32)
 
     # -- rotation + rotated centers ----------------------------------------
     rotation = _make_rotation(k_rot, rot_dim, d, params.force_random_rotation)
@@ -375,7 +520,9 @@ def build(
     nt = t_resid.shape[0]
     per_cluster = params.codebook_kind == PER_CLUSTER
 
-    if not per_cluster:
+    if nibble:
+        pq_centers = _train_nibble_books(t_resid, k_cb, params.kmeans_n_iters)
+    elif not per_cluster:
         # [pq_dim, nt, pq_len] stacks; one vmapped Lloyd trains all subspaces.
         Xs = jnp.transpose(t_resid, (1, 0, 2))
         mask = jnp.ones((pq_dim, nt), jnp.float32)
@@ -450,6 +597,9 @@ def build(
         codes_dev, jnp.arange(n, dtype=jnp.int32), slot, n_lists=n_lists, max_list=max_list
     )
     rot_sqnorms = _sqnorms_for(codes, centers_rot, pq_centers, per_cluster)
+    packed = params.pq_bits == 4 and pq_dim % 2 == 0
+    if packed:
+        codes = pack_codes(codes)
 
     return IvfPqIndex(
         centers=centers,
@@ -465,6 +615,9 @@ def build(
         pq_bits=params.pq_bits,
         size=n,
         list_cap_factor=params.list_cap_factor,
+        additive=nibble,
+        packed=packed,
+        center_rank=center_rank,
     )
 
 
@@ -488,7 +641,7 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     flat_ids = index.list_indices.reshape(-1)
     n_old = int(index.size)
     keep_order = jnp.argsort(flat_ids < 0)[:n_old]
-    old_codes = index.codes.reshape(-1, index.pq_dim)[keep_order]
+    old_codes = index.codes_unpacked().reshape(-1, index.pq_dim)[keep_order]
     old_ids = flat_ids[keep_order]
     old_l1 = (keep_order // index.max_list).astype(jnp.int32)
 
@@ -519,12 +672,13 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     codes, list_indices, list_sizes = ivf_common.scatter_rows(
         all_codes, all_ids, slot, n_lists=n_lists, max_list=max_list
     )
+    sqn = _sqnorms_for(codes, index.centers_rot, index.pq_centers, per_cluster)
     return dataclasses.replace(
         index,
-        codes=codes,
+        codes=pack_codes(codes) if index.packed else codes,
         list_indices=list_indices,
         list_sizes=list_sizes,
-        rot_sqnorms=_sqnorms_for(codes, index.centers_rot, index.pq_centers, per_cluster),
+        rot_sqnorms=sqn,
         size=index.size + n_new,
     )
 
@@ -836,13 +990,17 @@ def search(
     id -1. Distances are PQ approximations — pair with
     :func:`raft_tpu.neighbors.refine.refine` for exact re-ranking.
 
-    ``mode``: ``"scan"`` = dense decode-and-score over list chunks (see
-    :func:`_ivf_pq_scan_impl` — the TPU-fast path; same probed candidate
-    set, selected with the fused APPROXIMATE top-k so results can differ
-    slightly from the deterministic probe path); ``"probe"`` = per-probe
-    LUT gather (the literal analog of the reference's kernel schedule;
-    better for single-digit query batches); ``"auto"`` picks scan for
-    batches >= 128 queries."""
+    ``mode``: ``"fused"`` = the Pallas fused probed-list scan (DMAs only
+    the probed CODE blocks — the work-proportional TPU fast path, see
+    :mod:`raft_tpu.ops.pallas.pq_scan`; needs ksub <= 64 or additive
+    nibble codebooks, per_subspace, and a supported metric); ``"scan"`` =
+    dense decode-and-score over list chunks (see
+    :func:`_ivf_pq_scan_impl` — same probed candidate set, selected with
+    the fused APPROXIMATE top-k so results can differ slightly from the
+    deterministic probe path); ``"probe"`` = per-probe LUT gather (the
+    literal analog of the reference's kernel schedule; better for
+    single-digit query batches); ``"auto"`` picks fused on TPU when
+    eligible for batches >= 128, else scan/probe by batch size."""
     ensure_resources(res)
     if params is None:
         params = IvfPqSearchParams(**kwargs)
@@ -855,12 +1013,79 @@ def search(
     nq = queries.shape[0]
     filter_bits = prefilter.bits if prefilter is not None else None
 
+    fused_ok = (
+        index.codebook_kind == PER_SUBSPACE
+        and (index.additive or index.packed or index.ksub <= 64)
+        and index.metric in _SUPPORTED
+    )
     if mode == "auto":
-        mode = "scan" if nq >= 128 else "probe"
-    expects(mode in ("scan", "probe"), "mode must be auto|scan|probe, got %r", mode)
+        if nq >= 128 and jax.default_backend() == "tpu" and fused_ok:
+            mode = "fused"
+        else:
+            mode = "scan" if nq >= 128 else "probe"
+    expects(
+        mode in ("scan", "probe", "fused"), "mode must be auto|scan|probe|fused, got %r", mode
+    )
+
+    if mode == "fused":
+        from raft_tpu.ops.pallas.pq_scan import ivf_pq_fused_search
+
+        expects(fused_ok, "fused mode needs per_subspace + (ksub<=64 | nibble | packed)")
+        if index.additive:
+            books, code_mode, ksub = nibble_books(index.pq_centers), "nib8", 16
+        elif index.packed:
+            # packed codes: byte b = (code 2b, code 2b+1); W's natural
+            # [nq, pq_dim, 16] flattening is exactly the kernel's per-byte
+            # [lo-hot | hi-hot] column order, so books pass through as-is
+            books, code_mode, ksub = index.pq_centers, "p4", 16
+        else:
+            books, code_mode, ksub = index.pq_centers, "u8", index.ksub
+        rank = index.center_rank
+        group = params.fused_group
+        if rank is None:
+            # pre-v4 index: lists are in arbitrary k-means order — compute
+            # a rank for tile coherence, single-list DMA units for safety
+            from raft_tpu.neighbors.ivf_flat import _legacy_rank_cache
+
+            rank = _legacy_rank_cache(index.centers)
+            group = 1
+        group = max(1, min(group, index.n_lists))
+        while index.n_lists % group:
+            group -= 1
+
+        def run_fused(qc):
+            return ivf_pq_fused_search(
+                index.centers,
+                index.centers_rot,
+                rank,
+                index.rotation,
+                books,
+                index.codes,
+                index.list_indices,
+                index.rot_sqnorms,
+                qc,
+                filter_bits,
+                k=k,
+                n_probes=n_probes,
+                metric=index.metric,
+                qt=params.fused_qt,
+                probe_factor=params.fused_probe_factor,
+                group=group,
+                has_filter=filter_bits is not None,
+                merge=params.fused_merge,
+                code_mode=code_mode,
+                ksub=ksub,
+                extract_every=params.fused_extract_every,
+                interpret=jax.default_backend() != "tpu",
+            )
+
+        from raft_tpu.neighbors.ivf_flat import _batched_search
+
+        return _batched_search(run_fused, queries, query_batch)
 
     if mode == "scan":
         g = scan_chunk_lists(index.n_lists, index.max_list)
+        codes_u = index.codes_unpacked()
         out_v, out_i = [], []
         for start in range(0, nq, query_batch):
             qc = queries[start : start + query_batch]
@@ -873,7 +1098,7 @@ def search(
                 index.centers_rot,
                 index.rotation,
                 index.pq_centers,
-                index.codes,
+                codes_u,
                 index.list_indices,
                 index.rot_sqnorms,
                 qc.astype(jnp.float32),
@@ -901,6 +1126,7 @@ def search(
     per_q = max(1, index.pq_dim * index.max_list * 4)
     query_batch = max(1, min(query_batch, (512 << 20) // per_q))
 
+    codes_u = index.codes_unpacked()
     out_v, out_i = [], []
     for start in range(0, nq, query_batch):
         qc = queries[start : start + query_batch]
@@ -913,7 +1139,7 @@ def search(
             index.centers_rot,
             index.rotation,
             index.pq_centers,
-            index.codes,
+            codes_u,
             index.list_indices,
             qc,
             filter_bits,
@@ -938,7 +1164,7 @@ def search(
 # ---------------------------------------------------------------------------
 
 _KIND = "ivf_pq"
-_VERSION = 2
+_VERSION = 3
 
 
 def save(index: IvfPqIndex, stream: BinaryIO) -> None:
@@ -948,6 +1174,9 @@ def save(index: IvfPqIndex, stream: BinaryIO) -> None:
     ser.serialize_scalar(stream, int(index.pq_bits), "int32")
     ser.serialize_scalar(stream, int(index.codebook_kind == PER_CLUSTER), "int32")
     ser.serialize_scalar(stream, float(index.list_cap_factor), "float64")
+    ser.serialize_scalar(stream, int(index.additive), "int32")
+    ser.serialize_scalar(stream, int(index.packed), "int32")
+    ser.serialize_scalar(stream, int(index.center_rank is not None), "int32")
     ser.serialize_array(stream, index.centers)
     ser.serialize_array(stream, index.centers_rot)
     ser.serialize_array(stream, index.rotation)
@@ -956,6 +1185,8 @@ def save(index: IvfPqIndex, stream: BinaryIO) -> None:
     ser.serialize_array(stream, index.list_indices)
     ser.serialize_array(stream, index.list_sizes)
     ser.serialize_array(stream, index.rot_sqnorms)
+    if index.center_rank is not None:
+        ser.serialize_array(stream, index.center_rank)
 
 
 def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfPqIndex:
@@ -966,6 +1197,12 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfPqIndex:
     pq_bits = int(ser.deserialize_scalar(stream, "int32"))
     per_cluster = bool(ser.deserialize_scalar(stream, "int32"))
     cap_factor = float(ser.deserialize_scalar(stream, "float64")) if version >= 2 else 0.0
+    additive = packed = False
+    has_rank = False
+    if version >= 3:
+        additive = bool(ser.deserialize_scalar(stream, "int32"))
+        packed = bool(ser.deserialize_scalar(stream, "int32"))
+        has_rank = bool(ser.deserialize_scalar(stream, "int32"))
     centers = ser.deserialize_array(stream)
     centers_rot = ser.deserialize_array(stream)
     rotation = ser.deserialize_array(stream)
@@ -977,6 +1214,7 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfPqIndex:
         rot_sqnorms = ser.deserialize_array(stream)
     else:
         rot_sqnorms = _sqnorms_for(codes, centers_rot, pq_centers, per_cluster)
+    center_rank = ser.deserialize_array(stream) if has_rank else None
     return IvfPqIndex(
         centers=centers,
         centers_rot=centers_rot,
@@ -991,4 +1229,7 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfPqIndex:
         pq_bits=pq_bits,
         size=size,
         list_cap_factor=cap_factor,
+        additive=additive,
+        packed=packed,
+        center_rank=center_rank,
     )

@@ -546,6 +546,8 @@ def ivf_flat_fused_search(
     # flat slot order, so slots map straight back to list_indices.
     n_units = n_lists // group
     gm = group * m
+    if col_chunk and not merge.startswith("bank"):
+        col_chunk = 0  # chunked scoring only exists for the bank merge
     if col_chunk:
         # round down to a divisor of the block rows (0 disables chunking)
         cc = min(col_chunk, gm)

@@ -1,0 +1,164 @@
+"""Fused Pallas IVF-PQ scan: correctness vs brute force + the scan path,
+nibble/packed code layouts, serialization round-trip.
+
+Reference test analog: ``cpp/test/neighbors/ann_ivf_pq.cuh`` recall-
+threshold pattern (compare against exact kNN, assert recall floor).
+Runs in interpret mode on CPU.
+"""
+import io
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.neighbors import brute_force, ivf_pq
+from raft_tpu.ops.distance import DistanceType
+from raft_tpu.stats import neighborhood_recall
+
+
+def _data(seed=0, n=4000, d=32, nq=128):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((20, d)).astype(np.float32) * 3
+    ds = centers[rng.integers(0, 20, n)] + rng.standard_normal((n, d)).astype(np.float32)
+    qs = centers[rng.integers(0, 20, nq)] + rng.standard_normal((nq, d)).astype(np.float32)
+    return ds, qs
+
+
+def _gt(ds, qs, k, metric=DistanceType.L2Expanded):
+    bf = brute_force.build(ds, metric=metric)
+    _, bi = brute_force.search(bf, qs, k)
+    return np.asarray(bi)
+
+
+@pytest.mark.parametrize("pq_bits", [4, 5, 6])
+def test_fused_matches_brute_force_small_ksub(pq_bits):
+    ds, qs = _data(seed=1)
+    k = 10
+    idx = ivf_pq.build(
+        ds,
+        ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=pq_bits, seed=3),
+    )
+    assert idx.packed == (pq_bits == 4)
+    v, i = ivf_pq.search(
+        idx, qs, k,
+        ivf_pq.IvfPqSearchParams(n_probes=16, fused_qt=16, fused_probe_factor=16, fused_group=4),
+        mode="fused",
+    )
+    rec = float(neighborhood_recall(np.asarray(i), _gt(ds, qs, k)))
+    # ADC with small codebooks on 2-dim subspaces: recall floor from the
+    # measured operating point (0.55 / 0.69 / 0.77) minus slack
+    assert rec > 0.48 + 0.06 * (pq_bits - 4), rec
+    # fused and scan paths share the candidate set: near-identical recall
+    v2, i2 = ivf_pq.search(idx, qs, k, ivf_pq.IvfPqSearchParams(n_probes=16), mode="scan")
+    rec2 = float(neighborhood_recall(np.asarray(i2), _gt(ds, qs, k)))
+    assert abs(rec - rec2) < 0.08, (rec, rec2)
+
+
+def test_fused_nibble_beats_pq4():
+    ds, qs = _data(seed=2)
+    k = 10
+    common = dict(n_lists=16, pq_dim=16, seed=3)
+    idx4 = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(pq_bits=4, **common))
+    idx_nib = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(pq_bits=8, pq_kind="nibble", **common))
+    assert idx_nib.additive and not idx_nib.packed
+    sp = ivf_pq.IvfPqSearchParams(n_probes=16, fused_qt=16, fused_probe_factor=16, fused_group=4)
+    _, i4 = ivf_pq.search(idx4, qs, k, sp, mode="fused")
+    _, inib = ivf_pq.search(idx_nib, qs, k, sp, mode="fused")
+    gt = _gt(ds, qs, k)
+    r4 = float(neighborhood_recall(np.asarray(i4), gt))
+    rnib = float(neighborhood_recall(np.asarray(inib), gt))
+    # 256 additive centers must beat 16 plain centers per subspace
+    assert rnib > r4 + 0.02, (rnib, r4)
+
+
+def test_fused_inner_product():
+    ds, qs = _data(seed=4)
+    k = 8
+    idx = ivf_pq.build(
+        ds,
+        ivf_pq.IvfPqIndexParams(
+            n_lists=16, pq_dim=16, pq_bits=8, pq_kind="nibble",
+            metric=DistanceType.InnerProduct, seed=5,
+        ),
+    )
+    v, i = ivf_pq.search(
+        idx, qs, k,
+        ivf_pq.IvfPqSearchParams(n_probes=16, fused_qt=16, fused_probe_factor=16, fused_group=4),
+        mode="fused",
+    )
+    rec = float(neighborhood_recall(np.asarray(i), _gt(ds, qs, k, DistanceType.InnerProduct)))
+    assert rec > 0.6, rec
+
+
+def test_fused_prefilter():
+    from raft_tpu.core.bitset import Bitset
+
+    ds, qs = _data(seed=6)
+    k = 5
+    idx = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=6, seed=7))
+    banned = np.arange(0, ds.shape[0], 2)
+    bs = Bitset.from_unset_indices(ds.shape[0], jnp.asarray(banned, jnp.int32))
+    _, i = ivf_pq.search(
+        idx, qs, k,
+        ivf_pq.IvfPqSearchParams(n_probes=8, fused_qt=16, fused_probe_factor=8, fused_group=2),
+        prefilter=bs,
+        mode="fused",
+    )
+    out = np.asarray(i)
+    assert (out[out >= 0] % 2 == 1).all()  # only odd ids survive
+
+
+def test_packed_codes_round_trip():
+    ds, _ = _data(seed=8)
+    idx = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=4, seed=9))
+    assert idx.packed
+    assert idx.codes.shape[2] == 8  # pq_dim/2 bytes per row
+    up = ivf_pq.unpack_codes(idx.codes)
+    assert up.shape[2] == 16
+    assert (np.asarray(ivf_pq.pack_codes(up)) == np.asarray(idx.codes)).all()
+    assert int(np.asarray(up).max()) < 16
+
+
+def test_packed_index_smaller_than_8bit():
+    ds, _ = _data(seed=8)
+    idx4 = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=4, seed=9))
+    idx8 = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=8, seed=9))
+    b4 = io.BytesIO()
+    b8 = io.BytesIO()
+    ivf_pq.save(idx4, b4)
+    ivf_pq.save(idx8, b8)
+    # code storage halves; codebook shrinks 16x — the serialized file must
+    # show the memory win (VERDICT r3 item 5)
+    assert len(b4.getvalue()) < 0.7 * len(b8.getvalue())
+
+
+def test_serialize_v3_round_trip_nibble():
+    ds, qs = _data(seed=10)
+    k = 5
+    idx = ivf_pq.build(
+        ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=8, pq_kind="nibble", seed=11)
+    )
+    buf = io.BytesIO()
+    ivf_pq.save(idx, buf)
+    buf.seek(0)
+    idx2 = ivf_pq.load(buf)
+    assert idx2.additive and idx2.center_rank is not None
+    sp = ivf_pq.IvfPqSearchParams(n_probes=8, fused_qt=16, fused_probe_factor=8, fused_group=2)
+    _, i1 = ivf_pq.search(idx, qs, k, sp, mode="fused")
+    _, i2 = ivf_pq.search(idx2, qs, k, sp, mode="fused")
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_extend_packed():
+    ds, qs = _data(seed=12)
+    idx = ivf_pq.build(ds[:3000], ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=4, seed=13))
+    idx2 = ivf_pq.extend(idx, ds[3000:])
+    assert idx2.size == ds.shape[0]
+    assert idx2.packed and idx2.codes.shape[2] == 8
+    _, i = ivf_pq.search(
+        idx2, qs, 5,
+        ivf_pq.IvfPqSearchParams(n_probes=8, fused_qt=16, fused_probe_factor=8, fused_group=2),
+        mode="fused",
+    )
+    assert int(np.asarray(i).max()) >= 3000  # extended rows are findable

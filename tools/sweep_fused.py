@@ -82,17 +82,14 @@ def main():
     else:
         plans = {
             1.1: [
-                (20, 32, 4, 128, "bank4", 0, 0),
-                (20, 32, 8, 128, "bank8", 0, 1024),
-                (20, 32, 8, 128, "bank8", 8, 1024),
-                (20, 32, 8, 128, "bank8", 0, 512),
-                (20, 32, 8, 128, "bank16", 0, 1024),
-                (20, 64, 8, 256, "bank8", 0, 1024),
-                (20, 64, 8, 256, "bank8", 0, 2048),
-                (20, 64, 16, 256, "bank8", 0, 1024),
                 (30, 32, 8, 128, "bank8", 0, 1024),
-                (50, 32, 8, 128, "bank8", 0, 1024),
-                (20, 16, 8, 128, "bank8", 0, 1024),
+                (30, 24, 8, 256, "bank4", 0, 1024),
+                (30, 16, 8, 256, "bank4", 0, 1024),
+                (20, 24, 8, 256, "bank4", 0, 1024),
+                (30, 24, 8, 512, "bank4", 0, 1024),
+                (20, 32, 8, 512, "bank4", 0, 1024),
+                (30, 16, 8, 512, "bank4", 0, 1024),
+                (50, 16, 8, 256, "bank4", 0, 1024),
             ],
         }
 
