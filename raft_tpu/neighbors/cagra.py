@@ -95,6 +95,12 @@ class CagraSearchParams:
     max_iterations: int = 0  # 0 = auto (search_plan.cuh:136 adjust)
     seed: int = 0
     init_sample: int = 4096
+    # dedup=False skips the sort-based candidate deduplication per
+    # iteration (roughly halves the VPU sort work). Duplicate ids can then
+    # occupy multiple buffer slots, wasting capacity; compensate with a
+    # modestly larger itopk. The visited-flag logic is positional, so
+    # correctness is unaffected — only buffer efficiency.
+    dedup: bool = True
 
 
 @dataclasses.dataclass
@@ -313,11 +319,20 @@ def build(
         from raft_tpu.neighbors.refine import refine as refine_fn
 
         # build_knn_graph via IVF-PQ search over the dataset itself + exact
-        # re-rank (cagra_build.cuh:47-146)
+        # re-rank (cagra_build.cuh:47-146). Additive-nibble codebooks make
+        # the index eligible for the fused Pallas scan, which is what
+        # makes this path the fast 1M-scale default (vs ~16 min of
+        # NN-descent local joins on the same hardware).
         pq = ivf_pq_mod.build(
             dataset,
             ivf_pq_mod.IvfPqIndexParams(
-                n_lists=max(1, min(1024, n // 128)), metric=metric, seed=params.seed
+                n_lists=max(1, min(1024, n // 128)),
+                metric=metric,
+                seed=params.seed,
+                pq_kind="nibble" if metric in _SUPPORTED else "kmeans",
+                kmeans_n_iters=10,
+                kmeans_trainset_fraction=min(1.0, max(0.05, 100_000 / max(n, 1))),
+                list_cap_factor=1.1,
             ),
         )
         top = kin + 1
@@ -361,9 +376,28 @@ def from_graph(dataset, graph, metric=DistanceType.L2Expanded) -> CagraIndex:
 # ---------------------------------------------------------------------------
 
 
+def _pick_positions(vals, w: int, worst):
+    """Positions of the ``w`` best entries per row via w rounds of
+    min-extract — VPU compare/select passes instead of the full sort
+    ``lax.top_k`` lowers to (the beam only needs 1-4 parents out of
+    itopk, so a sort is ~10x overkill per iteration)."""
+    cols = lax.broadcasted_iota(jnp.int32, vals.shape, 1)
+    big = jnp.int32(2**30)
+    poss, valids = [], []
+    for _ in range(w):
+        mv = jnp.min(vals, axis=1, keepdims=True)
+        sel = jnp.min(jnp.where(vals == mv, cols, big), axis=1, keepdims=True)
+        poss.append(sel)
+        valids.append(mv != worst)
+        vals = jnp.where(cols == sel, worst, vals)
+    return jnp.concatenate(poss, axis=1), jnp.concatenate(valids, axis=1)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "itopk", "width", "iters", "metric", "has_filter", "use_vpq"),
+    static_argnames=(
+        "k", "itopk", "width", "iters", "metric", "has_filter", "use_vpq", "dedup"
+    ),
 )
 def _cagra_search_impl(
     dataset,
@@ -381,6 +415,7 @@ def _cagra_search_impl(
     metric: DistanceType,
     has_filter: bool,
     use_vpq: bool = False,
+    dedup: bool = True,
 ):
     nq, d = queries.shape
     n, deg = graph.shape
@@ -479,11 +514,13 @@ def _cagra_search_impl(
 
     def body(_, carry):
         buf_v, buf_i, buf_f = carry
-        # pickup_next_parents (:54): best `width` unvisited entries
+        # pickup_next_parents (:54): best `width` unvisited entries —
+        # width rounds of min-extract, not a full sort
         masked = jnp.where(buf_f | (buf_i < 0), worst, buf_v)
-        _, ppos = select_k(masked, width, select_min=select_min)
+        ppos, pvalid = _pick_positions(
+            masked if select_min else -masked, width, jnp.inf
+        )
         parents = jnp.take_along_axis(buf_i, ppos, axis=1)  # [nq, width]
-        pvalid = jnp.take_along_axis(masked, ppos, axis=1) != worst
         parents = jnp.where(pvalid, parents, -1)
         rows = jnp.arange(nq)[:, None]
         buf_f = buf_f.at[rows, ppos].set(True)
@@ -491,11 +528,30 @@ def _cagra_search_impl(
         nbrs = graph[jnp.clip(parents, 0, None)]  # [nq, width, deg]
         nbrs = jnp.where(parents[:, :, None] >= 0, nbrs, -1).reshape(nq, width * deg)
         dist = score(nbrs)
-        return running_merge_unique(
-            buf_v, buf_i, dist, nbrs, select_min=select_min, acc_flags=buf_f
-        )
+        if dedup:
+            return running_merge_unique(
+                buf_v, buf_i, dist, nbrs, select_min=select_min, acc_flags=buf_f
+            )
+        # plain merge: one selection, no sort-dedup; duplicate ids may
+        # hold several slots (see CagraSearchParams.dedup)
+        vals = jnp.concatenate([buf_v, jnp.where(nbrs < 0, worst, dist)], axis=1)
+        ids = jnp.concatenate([buf_i, nbrs], axis=1)
+        flg = jnp.concatenate([buf_f, jnp.zeros(nbrs.shape, bool)], axis=1)
+        out_v, pos = select_k(vals, itopk, select_min=select_min)
+        out_i = jnp.take_along_axis(ids, pos, axis=1)
+        out_f = jnp.take_along_axis(flg, pos, axis=1)
+        out_i = jnp.where(out_v == worst, -1, out_i)
+        return out_v, out_i, out_f
 
     buf_v, buf_i, buf_f = lax.fori_loop(0, iters, body, (buf_v, buf_i, buf_f))
+    if not dedup:
+        # one final sort-dedup so duplicate ids cannot occupy several of
+        # the returned top-k slots
+        buf_v, buf_i, buf_f = running_merge_unique(
+            buf_v, buf_i,
+            jnp.full((nq, 1), worst, jnp.float32), jnp.full((nq, 1), -1, jnp.int32),
+            select_min=select_min, acc_flags=buf_f,
+        )
 
     vals, idx = buf_v[:, :k], buf_i[:, :k]
     if metric == DistanceType.L2SqrtExpanded:
@@ -596,6 +652,7 @@ def search(
             metric=index.metric,
             has_filter=filter_bits is not None,
             use_vpq=use_vpq,
+            dedup=params.dedup,
         )
         if bpad:
             v, i = v[:-bpad], i[:-bpad]

@@ -149,6 +149,79 @@ def ppermute(x, perm: Sequence[tuple], axis: str = DEFAULT_AXIS):
     return lax.ppermute(x, axis, perm)
 
 
+def gather(x, root: int = 0, axis: str = DEFAULT_AXIS):
+    """``comms_t::gather`` (``core/comms.hpp:400``): root receives every
+    rank's block stacked on a new leading axis; other ranks get zeros.
+    XLA collectives are symmetric, so this is an all_gather + root mask —
+    same ICI cost, and the mask keeps the verb's contract."""
+    g = lax.all_gather(x, axis)
+    is_root = lax.axis_index(axis) == root
+    return jax.tree_util.tree_map(lambda a: jnp.where(is_root, a, jnp.zeros_like(a)), g)
+
+
+def gatherv(x, valid_n, root: int = 0, axis: str = DEFAULT_AXIS):
+    """``comms_t::gatherv`` (``core/comms.hpp:417``): variable-size gather.
+    XLA needs static shapes, so each rank contributes a padded block ``x
+    [cap, ...]`` plus its true row count ``valid_n``; root receives
+    ``(blocks [size, cap, ...], sizes [size])`` and other ranks zeros.
+    Callers compact with the sizes (the raft recvcounts/displs analog)."""
+    blocks = lax.all_gather(x, axis)
+    sizes = lax.all_gather(jnp.asarray(valid_n, jnp.int32), axis)
+    is_root = lax.axis_index(axis) == root
+    mask = lambda a: jnp.where(is_root, a, jnp.zeros_like(a))  # noqa: E731
+    return mask(blocks), mask(sizes)
+
+
+def scatter(x, root: int = 0, axis: str = DEFAULT_AXIS):
+    """Inverse of :func:`gather`: ``x [size, ...]`` on root (every rank
+    passes the same-shaped buffer under SPMD); rank r receives block
+    ``x_root[r]``. (The reference exposes this through raft-dask's
+    scatter; the C++ iface covers it with device_send loops.)"""
+    x_root = bcast(x, root=root, axis=axis)
+    return jax.tree_util.tree_map(
+        lambda a: lax.dynamic_index_in_dim(a, lax.axis_index(axis), 0, keepdims=False),
+        x_root,
+    )
+
+
+def send_recv(x, src: int, dst: int, axis: str = DEFAULT_AXIS):
+    """One device p2p transfer (``comms_t::device_send``/``device_recv``
+    pair, ``core/comms.hpp:506-540``): rank ``dst`` receives ``src``'s
+    ``x``; every other rank (src included) gets zeros."""
+    return lax.ppermute(x, axis, [(src, dst)])
+
+
+def device_sendrecv(x, partner_of: Sequence[tuple], axis: str = DEFAULT_AXIS):
+    """``comms_t::device_sendrecv`` (``core/comms.hpp:559``): simultaneous
+    exchange — each (a, b) pair in ``partner_of`` ships a→b AND b→a in one
+    collective permute."""
+    perm = []
+    for a, b in partner_of:
+        perm.append((a, b))
+        perm.append((b, a))
+    return lax.ppermute(x, axis, perm)
+
+
+def multicast_sendrecv(x, pairs: Sequence[tuple], axis: str = DEFAULT_AXIS):
+    """``comms_t::device_multicast_sendrecv`` (``core/comms.hpp:580``):
+    one source may feed several destinations — not a permutation, so XLA's
+    ppermute cannot express it; an all_gather + per-rank source select
+    does (one extra ICI hop vs NCCL's grouped sends)."""
+    size = lax.axis_size(axis)
+    src_of = np.full((size,), -1, np.int64)
+    for s, d in pairs:
+        src_of[d] = s
+    g = lax.all_gather(x, axis)  # [size, ...]
+    my_src = jnp.asarray(src_of, jnp.int32)[lax.axis_index(axis)]
+    picked = jax.tree_util.tree_map(
+        lambda a: lax.dynamic_index_in_dim(a, jnp.maximum(my_src, 0), 0, keepdims=False),
+        g,
+    )
+    return jax.tree_util.tree_map(
+        lambda a: jnp.where(my_src >= 0, a, jnp.zeros_like(a)), picked
+    )
+
+
 def barrier(axis: str = DEFAULT_AXIS):
     """``comms_t::barrier`` (``core/comms.hpp:389``): XLA programs are
     bulk-synchronous per collective, so a tiny psum is a true rendezvous.

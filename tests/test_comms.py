@@ -233,3 +233,100 @@ class TestBootstrap:
         from raft_tpu.parallel import bootstrap
 
         assert bootstrap.run_comms_self_test(mesh) is True
+
+
+# ---------------------------------------------------------------------------
+# gather / gatherv / scatter / p2p pair (comms_test.hpp:156-230 analogs)
+# ---------------------------------------------------------------------------
+
+
+def test_gather_to_root(mesh):
+    # test_collective_gather: rank r contributes r; root receives [0..7],
+    # everyone else zeros.
+    x = jnp.arange(8, dtype=jnp.float32)
+
+    def body(xs):
+        return comms.gather(xs, root=2)  # [8, 1] per rank
+
+    out = np.asarray(run_spmd(mesh, body, x, out_specs=P(None, "data"))).reshape(8, 8)
+    np.testing.assert_array_equal(out[:, 2], np.arange(8, dtype=np.float32))
+    for col in [c for c in range(8) if c != 2]:
+        np.testing.assert_array_equal(out[:, col], np.zeros(8, np.float32))
+
+
+def test_gatherv_variable_sizes(mesh):
+    # test_collective_gatherv: rank r contributes r+1 valid rows (value r)
+    # inside a capacity-4 padded block; root reconstructs the ragged
+    # concatenation from (blocks, sizes).
+    cap = 4
+    x = jnp.repeat(jnp.arange(8, dtype=jnp.float32)[:, None], cap, axis=1).reshape(-1)
+
+    def body(xs):
+        r = comms.comm_rank()
+        valid = jnp.minimum(r + 1, cap)
+        blocks, sizes = comms.gatherv(xs, valid, root=0)
+        return blocks.reshape(1, -1), sizes.reshape(1, -1)
+
+    blocks, sizes = run_spmd(
+        mesh, body, x,
+        in_specs=(P("data"),), out_specs=(P("data", None), P("data", None)),
+    )
+    blocks = np.asarray(blocks)  # [8 ranks, 8*cap]
+    sizes = np.asarray(sizes)  # [8 ranks, 8]
+    np.testing.assert_array_equal(sizes[0], np.minimum(np.arange(8) + 1, cap))
+    root_blocks = blocks[0].reshape(8, cap)
+    for r in range(8):
+        n_valid = min(r + 1, cap)
+        np.testing.assert_array_equal(root_blocks[r, :n_valid], np.full(n_valid, float(r)))
+    assert (blocks[1:] == 0).all() and (sizes[1:] == 0).all()
+
+
+def test_scatter_from_root(mesh):
+    # root holds [10, 20, ..., 80]; rank r receives 10*(r+1)
+    x = jnp.tile((jnp.arange(8, dtype=jnp.float32) + 1) * 10, 8)
+
+    def body(xs):
+        # xs is this rank's [8] copy of the root buffer
+        return comms.scatter(xs, root=0)[None]
+
+    out = np.asarray(run_spmd(mesh, body, x, out_specs=P("data")))
+    np.testing.assert_array_equal(out, (np.arange(8) + 1) * 10.0)
+
+
+def test_send_recv_single_pair(mesh):
+    # test_pointToPoint_simple_send_recv: rank 1 sends its value to rank 5;
+    # only rank 5 receives it.
+    x = (jnp.arange(8, dtype=jnp.float32) + 1) * 100
+
+    def body(xs):
+        return comms.send_recv(xs, src=1, dst=5)
+
+    out = np.asarray(run_spmd(mesh, body, x, out_specs=P("data")))
+    expected = np.zeros(8, np.float32)
+    expected[5] = 200.0
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_device_sendrecv_exchange(mesh):
+    # test_pointToPoint_device_sendrecv: pairs (0,1) (2,3) ... swap values.
+    x = jnp.arange(8, dtype=jnp.float32)
+
+    def body(xs):
+        return comms.device_sendrecv(xs, [(0, 1), (2, 3), (4, 5), (6, 7)])
+
+    out = np.asarray(run_spmd(mesh, body, x, out_specs=P("data")))
+    np.testing.assert_array_equal(out, np.array([1, 0, 3, 2, 5, 4, 7, 6], np.float32))
+
+
+def test_multicast_sendrecv(mesh):
+    # test_pointToPoint_device_multicast_sendrecv: rank 0 multicasts to
+    # 1, 2, 3 via three permute edges.
+    x = (jnp.arange(8, dtype=jnp.float32) + 1) * 7
+
+    def body(xs):
+        return comms.multicast_sendrecv(xs, [(0, 1), (0, 2), (0, 3)])
+
+    out = np.asarray(run_spmd(mesh, body, x, out_specs=P("data")))
+    expected = np.zeros(8, np.float32)
+    expected[1:4] = 7.0
+    np.testing.assert_array_equal(out, expected)
