@@ -5,7 +5,12 @@ distances + kNN, kNN-graph construction, MST and Lanczos solvers.
 See ``SURVEY.md`` §2.3 (``/root/reference/cpp/include/raft/sparse``).
 """
 from raft_tpu.sparse import linalg
-from raft_tpu.sparse.distance import knn_sparse, pairwise_distance_sparse
+from raft_tpu.sparse.distance import (
+    knn_sparse,
+    pairwise_distance_sparse,
+    pairwise_distance_sparse_native,
+    sparse_gram,
+)
 from raft_tpu.sparse.neighbors import cross_component_nn, knn_graph
 from raft_tpu.sparse.solver import MSTResult, lanczos, mst
 from raft_tpu.sparse.types import COO, CSR, coo_from_dense, coo_to_csr, csr_from_dense
@@ -24,4 +29,6 @@ __all__ = [
     "linalg",
     "mst",
     "pairwise_distance_sparse",
+    "pairwise_distance_sparse_native",
+    "sparse_gram",
 ]

