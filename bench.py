@@ -3,15 +3,30 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
 Covers all four index families (brute-force exact + fused-approx,
-IVF-Flat, IVF-PQ (+refine), CAGRA) on synthetic clustered 1M x 128
-float32 — the SIFT-1M shape of BASELINE.md — at batch 1024, reporting
+IVF-Flat fused, IVF-PQ fused (+refine), CAGRA) at batch 1024, reporting
 each algorithm's best QPS at the recall@10 >= 0.95 operating point (the
 reference harness's headline, ``benchmark.hpp:330-385``).
 
-Headline ``value`` = best QPS@0.95 across algorithms. ``vs_baseline``
+Dataset: synthetic clustered 1M x 128 float32 (the SIFT-1M shape of
+BASELINE.md; zero-egress environment) — OR a real dataset when
+``RAFT_TPU_BENCH_DATASET`` names one: either a registry name resolved by
+``raft_tpu.bench.datasets.get_dataset`` (reads
+``$RAFT_TPU_BENCH_DATA/<name>/{base,query}.fbin`` when present) or a
+directory containing ``base.fbin`` + ``query.fbin``.
+
+Headline ``value`` = best QPS@0.95 across algorithms (metric name kept
+STABLE across rounds for the synthetic default). ``vs_baseline``
 normalizes against 600k QPS — the A100 SIFT-1M IVF-PQ throughput class
 BASELINE.md sets as the north star (the reference publishes no absolute
 tables, so this is a nominal constant kept fixed across rounds).
+
+``extra.hw_context`` reports measured HBM copy bandwidth and bf16 matmul
+throughput at bench time: this TPU is time-shared behind a tunnel and
+wall-times swing ~2x with tenancy, so the headline only means something
+next to the hardware's throughput at that moment.
+
+Artifacts: gbench-style JSON + CSV (data_export) + recall/QPS Pareto PNG
+(plot) under ``bench_artifacts/`` — the raft-ann-bench output surface.
 
 Everything (data gen, builds, searches) runs on-device; only [nq, k]
 results and scalars cross the host link (which on tethered dev TPUs is
@@ -61,12 +76,49 @@ def _timed(fn, nrep=2, inner=4):
     return best, out
 
 
-def main():
-    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
-    from raft_tpu.neighbors.refine import refine
-    from raft_tpu.ops.distance import DistanceType
+def _hw_context():
+    """Measure the chip's throughput RIGHT NOW (time-shared tenancy makes
+    this swing ~2x): HBM copy GB/s + bf16 matmul TFLOP/s."""
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (32 * 1024 * 1024,), jnp.float32)  # 128 MB
+    f = jax.jit(lambda x: x * 1.0001 + 1.0)
+    y = f(x)
+    float(jnp.sum(y[:1]))
+    t0 = time.perf_counter()
+    for _ in range(4):
+        y = f(y)
+    float(jnp.sum(y[:1]))
+    copy_gbps = 2 * 128 / ((time.perf_counter() - t0) / 4) / 1000
+    a = jax.random.normal(key, (4096, 4096), jnp.bfloat16)
+    m = jax.jit(lambda a: (a @ a).astype(jnp.bfloat16))
+    b = m(a)
+    float(jnp.sum(b[:1, :1].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(4):
+        b = m(b)
+    float(jnp.sum(b[:1, :1].astype(jnp.float32)))
+    tflops = 2 * 4096**3 / ((time.perf_counter() - t0) / 4) / 1e12
+    return {"hbm_copy_gbps": round(copy_gbps, 1), "bf16_matmul_tflops": round(tflops, 1)}
 
-    t_all = time.perf_counter()
+
+def _load_data():
+    """Synthetic clustered default, or a real dataset via
+    RAFT_TPU_BENCH_DATASET (name or directory with base/query .fbin)."""
+    spec = os.environ.get("RAFT_TPU_BENCH_DATASET", "")
+    if spec:
+        from raft_tpu.bench import datasets as bd
+
+        if os.path.isdir(spec):
+            ds = bd.load_fbin_dataset(
+                os.path.basename(spec.rstrip("/")),
+                os.path.join(spec, "base.fbin"),
+                os.path.join(spec, "query.fbin"),
+            )
+        else:
+            ds = bd.get_dataset(spec)
+        dataset = jnp.asarray(ds.base, jnp.float32)
+        queries = jnp.asarray(ds.queries[:NQ], jnp.float32)
+        return dataset, queries, f"dataset={ds.name} n={ds.n} dim={ds.dim}"
     key = jax.random.PRNGKey(1234)
     kc, ka, kb, kq1, kq2 = jax.random.split(key, 5)
     centers = jax.random.normal(kc, (N_CENTERS, D), jnp.float32)
@@ -76,12 +128,25 @@ def main():
     queries = centers[jax.random.randint(kq1, (NQ,), 0, N_CENTERS)] + CLUSTER_STD * jax.random.normal(
         kq2, (NQ, D), jnp.float32
     )
+    return dataset, queries, "synthetic clustered"
+
+
+def main():
+    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+    from raft_tpu.neighbors.refine import refine
+    from raft_tpu.ops.distance import DistanceType
+
+    t_all = time.perf_counter()
+    hw = _hw_context()
+    print(f"# hw: copy {hw['hbm_copy_gbps']} GB/s, bf16 {hw['bf16_matmul_tflops']} TFLOP/s", flush=True)
+    dataset, queries, source = _load_data()
+    nq = int(queries.shape[0])
     float(jnp.sum(dataset[0]))
 
     # ground truth + exact brute-force timing
     bf = brute_force.build(dataset, metric=DistanceType.L2Expanded)
     t_exact, (ev, ei) = _timed(
-        lambda: brute_force.search(bf, queries, K, query_batch=NQ, dataset_tile=262144),
+        lambda: brute_force.search(bf, queries, K, query_batch=nq, dataset_tile=262144),
         nrep=2,
     )
     gt = np.asarray(ei)
@@ -95,9 +160,9 @@ def main():
 
     def record(algo, config, dt, idx):
         results.setdefault(algo, []).append(
-            {"config": config, "qps": round(NQ / dt, 1), "recall": round(recall(idx), 4)}
+            {"config": config, "qps": round(nq / dt, 1), "recall": round(recall(idx), 4)}
         )
-        print(f"# {algo:16s} {config:34s} {NQ/dt:>12,.0f} qps  recall={results[algo][-1]['recall']:.4f}",
+        print(f"# {algo:16s} {config:40s} {nq/dt:>12,.0f} qps  recall={results[algo][-1]['recall']:.4f}",
               flush=True)
 
     build_times = {"brute_force": 0.0}
@@ -106,58 +171,61 @@ def main():
     dt, (v, i) = _timed(lambda: brute_force.search(bf, queries, K, mode="approx"))
     record("brute_force", "approx rt=0.99", dt, i)
 
+    # ---- IVF-Flat: fused Pallas scan, bf16 lists, bank merge -------------
     t0 = time.perf_counter()
     fidx = ivf_flat.build(
         dataset,
-        ivf_flat.IvfFlatIndexParams(n_lists=1024, kmeans_n_iters=10, kmeans_trainset_fraction=0.1),
+        ivf_flat.IvfFlatIndexParams(
+            n_lists=1024, kmeans_n_iters=10, kmeans_trainset_fraction=0.1,
+            list_cap_factor=1.1,
+        ),
     )
     float(jnp.sum(fidx.list_sizes))
     build_times["ivf_flat"] = round(time.perf_counter() - t0, 1)
-    # fused Pallas probed-list scan, bf16 lists (the TPU fast path)
     bf16_idx = dataclasses.replace(fidx, list_data=fidx.list_data.astype(jnp.bfloat16))
-    for npr, pf, g, qt, merge in (
-        (20, 64, 8, 128, "seg"),
-        (20, 32, 8, 128, "seg4"),
-        (50, 32, 8, 128, "seg"),
+    for npr, pf, g, merge in (
+        (30, 32, 8, "bank8"),
+        (20, 32, 8, "bank8"),
+        (30, 32, 16, "bank8"),
+        (50, 32, 8, "bank8"),
     ):
         sp = ivf_flat.IvfFlatSearchParams(
-            n_probes=npr, fused_qt=qt, fused_probe_factor=pf, fused_group=g,
-            fused_merge=merge, fused_precision="default",
+            n_probes=npr, fused_qt=128, fused_probe_factor=pf, fused_group=g,
+            fused_merge=merge, fused_precision="default", fused_col_chunk=1024,
         )
         dt, (v, i) = _timed(
             lambda sp=sp: ivf_flat.search(bf16_idx, queries, K, sp, mode="fused")
         )
         record("ivf_flat", f"fused bf16 npr={npr} pf={pf} G={g} {merge}", dt, i)
-    sp = ivf_flat.IvfFlatSearchParams(
-        n_probes=20, fused_qt=128, fused_probe_factor=32, fused_group=4,
-        fused_merge="seg4", fused_precision="default",
-    )
-    dt, (v, i) = _timed(lambda: ivf_flat.search(fidx, queries, K, sp, mode="fused"))
-    record("ivf_flat", "fused f32 npr=20 pf=32 G=4 seg4", dt, i)
-    dt, (v, i) = _timed(lambda: ivf_flat.search(fidx, queries, K, n_probes=20, mode="scan"))
-    record("ivf_flat", "scan nprobe=20", dt, i)
 
+    # ---- IVF-PQ: fused Pallas scan, additive nibble codebooks ------------
     t0 = time.perf_counter()
     pidx = ivf_pq.build(
         dataset,
-        ivf_pq.IvfPqIndexParams(n_lists=1024, pq_dim=64, kmeans_n_iters=10, kmeans_trainset_fraction=0.1),
+        ivf_pq.IvfPqIndexParams(
+            n_lists=1024, pq_dim=32, pq_bits=8, pq_kind="nibble",
+            kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
+        ),
     )
     float(jnp.sum(pidx.list_sizes))
     build_times["ivf_pq"] = round(time.perf_counter() - t0, 1)
-    sp = ivf_pq.IvfPqSearchParams(n_probes=50, lut_dtype=jnp.bfloat16)
-    dt, (v, i) = _timed(lambda: ivf_pq.search(pidx, queries, K, sp), nrep=2)
-    record("ivf_pq", "nprobe=50 bf16", dt, i)
+    code_mb = round(pidx.codes.size / 1e6, 1)
 
-    def pq_refined():
-        _, cand = ivf_pq.search(pidx, queries, 4 * K, sp)
+    sp30 = ivf_pq.IvfPqSearchParams(n_probes=30, fused_probe_factor=32, fused_group=8)
+    dt, (v, i) = _timed(lambda: ivf_pq.search(pidx, queries, K, sp30, mode="fused"), nrep=2)
+    record("ivf_pq", f"fused nib32 npr=30 ({code_mb}MB codes)", dt, i)
+
+    def pq_refined(sp, rr):
+        _, cand = ivf_pq.search(pidx, queries, rr * K, sp, mode="fused")
         return refine(dataset, queries, cand, K, metric=DistanceType.L2Expanded)
 
-    dt, (v, i) = _timed(pq_refined, nrep=2)
-    record("ivf_pq", "nprobe=50 bf16 refine=4x", dt, i)
+    for npr, rr in ((30, 8), (50, 8)):
+        sp = ivf_pq.IvfPqSearchParams(n_probes=npr, fused_probe_factor=32, fused_group=8)
+        dt, (v, i) = _timed(lambda sp=sp, rr=rr: pq_refined(sp, rr), nrep=2)
+        record("ivf_pq", f"fused nib32 npr={npr} refine={rr}x", dt, i)
 
+    # ---- CAGRA: ivf_pq-path graph build + no-dedup beam ------------------
     cagra_err = None
-    # CAGRA's 1M graph build costs ~20 min; skip it when the earlier phases
-    # already consumed the budget so the bench always finishes
     budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", 2400))
     if time.perf_counter() - t_all > budget_s:
         cagra_err = "skipped: time budget exhausted before CAGRA build"
@@ -169,21 +237,22 @@ def main():
         cidx = cagra.build(
             dataset,
             cagra.CagraIndexParams(
-                intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8
+                intermediate_graph_degree=32, graph_degree=16, build_algo=cagra.IVF_PQ
             ),
         )
         float(jnp.sum(cidx.graph[0].astype(jnp.float32)))
         build_times["cagra"] = round(time.perf_counter() - t0, 1)
-        for itopk, w in ((128, 4), (192, 4)):
+        for itopk, w, dd in ((160, 4, False), (128, 4, False), (192, 8, False)):
             dt, (v, i) = _timed(
-                lambda itopk=itopk, w=w: cagra.search(
-                    cidx, queries, K, cagra.CagraSearchParams(itopk_size=itopk, search_width=w)
+                lambda itopk=itopk, w=w, dd=dd: cagra.search(
+                    cidx, queries, K,
+                    cagra.CagraSearchParams(itopk_size=itopk, search_width=w, dedup=dd),
                 ),
                 nrep=2,
             )
-            record("cagra", f"itopk={itopk} width={w}", dt, i)
+            record("cagra", f"itopk={itopk} w={w} dedup={dd}", dt, i)
     except Exception as e:  # noqa: BLE001 — a single-algo failure must not kill the bench
-        cagra_err = f"{type(e).__name__}: {e}"[:200]
+        cagra_err = cagra_err or f"{type(e).__name__}: {e}"[:200]
         print(f"# cagra skipped: {cagra_err}", flush=True)
 
     # operating points: best QPS at recall >= MIN_RECALL per algorithm
@@ -193,6 +262,42 @@ def main():
         ops[algo] = max(ok, key=lambda r: r["qps"]) if ok else None
     reached = {a: r for a, r in ops.items() if r is not None}
     best_algo, best = max(reached.items(), key=lambda kv: kv[1]["qps"])
+
+    # ---- artifacts: gbench JSON + CSV + Pareto plot (L8 parity) ----------
+    artifacts = {}
+    try:
+        bench_doc = {
+            "context": {"device": str(jax.devices()[0]), "source": source, **hw},
+            "benchmarks": [
+                {
+                    "name": f"{algo}/{r['config']}",
+                    "algo": algo,
+                    "dataset": source,
+                    "k": K,
+                    "n_queries": nq,
+                    "Recall": r["recall"],
+                    "items_per_second": r["qps"],
+                    "Latency": round(nq / r["qps"], 6),
+                    "end_to_end": round(nq / r["qps"], 6),
+                    "build_time": build_times.get(algo.replace("_exact", ""), 0.0),
+                    "build_params": {},
+                    "search_params": {"config": r["config"]},
+                }
+                for algo, rows in results.items()
+                for r in rows
+            ],
+        }
+        os.makedirs("bench_artifacts", exist_ok=True)
+        with open("bench_artifacts/results.json", "w") as f:
+            json.dump(bench_doc, f, indent=2)
+        from raft_tpu.bench.data_export import export_csv
+        from raft_tpu.bench.plot import plot_report
+
+        artifacts["json"] = "bench_artifacts/results.json"
+        artifacts["csv"] = export_csv(bench_doc, "bench_artifacts/results.csv")
+        artifacts["plot"] = plot_report(bench_doc, "bench_artifacts/results.png")
+    except Exception as e:  # noqa: BLE001
+        artifacts["error"] = f"{type(e).__name__}: {e}"[:200]
 
     print(
         json.dumps(
@@ -211,9 +316,12 @@ def main():
                     "all_results": results,
                     "build_seconds": build_times,
                     "cagra_error": cagra_err,
-                    "n": N,
-                    "dim": D,
-                    "n_queries": NQ,
+                    "hw_context": hw,
+                    "data_source": source,
+                    "artifacts": artifacts,
+                    "n": int(dataset.shape[0]),
+                    "dim": int(dataset.shape[1]),
+                    "n_queries": nq,
                     "k": K,
                     "device": str(jax.devices()[0]),
                     "total_bench_seconds": round(time.perf_counter() - t_all, 1),

@@ -25,6 +25,8 @@ def main(argv=None) -> int:
     ap.add_argument("--min-recall", type=float, default=0.95)
     ap.add_argument("--min-search-time", type=float, default=2.0)
     ap.add_argument("--out", default=None, help="write gbench-style JSON report here")
+    ap.add_argument("--csv-out", default=None, help="also export results as CSV (data_export)")
+    ap.add_argument("--plot-out", default=None, help="also render the recall-QPS plot (PNG)")
     args = ap.parse_args(argv)
 
     ds = datasets.get_dataset(args.dataset)
@@ -59,6 +61,14 @@ def main(argv=None) -> int:
         print(f"# wrote {args.out}")
     else:
         print(json.dumps([r.to_json() for r in harness.pareto_frontier(all_results)], indent=2))
+    if args.csv_out:
+        from raft_tpu.bench.data_export import export_results_csv
+
+        print(f"# wrote {export_results_csv(all_results, args.csv_out)}")
+    if args.plot_out:
+        from raft_tpu.bench.plot import plot_results
+
+        print(f"# wrote {plot_results(all_results, args.plot_out)}")
     return 0
 
 

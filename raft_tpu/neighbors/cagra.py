@@ -95,12 +95,20 @@ class CagraSearchParams:
     max_iterations: int = 0  # 0 = auto (search_plan.cuh:136 adjust)
     seed: int = 0
     init_sample: int = 4096
-    # dedup=False skips the sort-based candidate deduplication per
-    # iteration (roughly halves the VPU sort work). Duplicate ids can then
-    # occupy multiple buffer slots, wasting capacity; compensate with a
-    # modestly larger itopk. The visited-flag logic is positional, so
-    # correctness is unaffected — only buffer efficiency.
-    dedup: bool = True
+    # Candidate deduplication strategy per iteration:
+    #   "sort" — id-sort + adjacent-compare + re-select (two sorts; the
+    #            round-3 default, exact).
+    #   "post" — single value-sort merge, then adjacent-id kill on the
+    #            RESULT: duplicates of one id carry the same distance, so
+    #            a stable value sort makes them adjacent, and the stable
+    #            tie order guarantees the visited (buffered) copy
+    #            survives. Half the sort work of "sort"; dup copies decay
+    #            to dead slots instead of re-selectable ghosts. Default.
+    #   "none" — no dedup. NOT recommended: unflagged duplicates of
+    #            already-expanded nodes get re-picked as parents forever
+    #            and the beam stalls (measured: recall 0.97 -> 0.39).
+    # True/False are accepted as aliases of "sort"/"none".
+    dedup: str = "post"
 
 
 @dataclasses.dataclass
@@ -323,23 +331,41 @@ def build(
         # the index eligible for the fused Pallas scan, which is what
         # makes this path the fast 1M-scale default (vs ~16 min of
         # NN-descent local joins on the same hardware).
+        import time as _time
+
+        from raft_tpu.core.logging import logger
+
+        t0 = _time.perf_counter()
         pq = ivf_pq_mod.build(
             dataset,
             ivf_pq_mod.IvfPqIndexParams(
                 n_lists=max(1, min(1024, n // 128)),
                 metric=metric,
                 seed=params.seed,
-                pq_kind="nibble" if metric in _SUPPORTED else "kmeans",
+                # pq_dim 32 keeps the fused decode LUT small (K = 32*32
+                # columns); graph-build shortlists only need coarse
+                # ranking, the exact refine below restores order
+                pq_dim=32 if d >= 64 and d % 32 == 0 else 0,
+                pq_kind="nibble",
                 kmeans_n_iters=10,
                 kmeans_trainset_fraction=min(1.0, max(0.05, 100_000 / max(n, 1))),
                 list_cap_factor=1.1,
             ),
         )
+        jax.block_until_ready(pq.codes)
+        t1 = _time.perf_counter()
         top = kin + 1
         _, cand = ivf_pq_mod.search(
-            pq, dataset, min(2 * top, pq.size), n_probes=32, query_batch=4096
+            pq, dataset, min(2 * top, pq.size), n_probes=24, query_batch=4096
         )
+        jax.block_until_ready(cand)
+        t2 = _time.perf_counter()
         _, nbrs = refine_fn(dataset, dataset, cand, top, metric=metric)
+        jax.block_until_ready(nbrs)
+        logger.info(
+            "cagra ivf_pq graph build: pq_build %.1fs, self-search %.1fs, refine %.1fs",
+            t1 - t0, t2 - t1, _time.perf_counter() - t2,
+        )
         # drop self-edges, keep kin per row: stable argsort pushes the (at
         # most one) self-edge per row to the end — on device (shipping the
         # [n, kin] graph through the host link costs minutes at 1M rows)
@@ -415,7 +441,7 @@ def _cagra_search_impl(
     metric: DistanceType,
     has_filter: bool,
     use_vpq: bool = False,
-    dedup: bool = True,
+    dedup: str = "post",
 ):
     nq, d = queries.shape
     n, deg = graph.shape
@@ -528,12 +554,13 @@ def _cagra_search_impl(
         nbrs = graph[jnp.clip(parents, 0, None)]  # [nq, width, deg]
         nbrs = jnp.where(parents[:, :, None] >= 0, nbrs, -1).reshape(nq, width * deg)
         dist = score(nbrs)
-        if dedup:
+        if dedup == "sort":
             return running_merge_unique(
                 buf_v, buf_i, dist, nbrs, select_min=select_min, acc_flags=buf_f
             )
-        # plain merge: one selection, no sort-dedup; duplicate ids may
-        # hold several slots (see CagraSearchParams.dedup)
+        # one value-sorted selection; "post" then kills adjacent duplicate
+        # ids on the result (equal ids carry equal distances, and stable
+        # tie order keeps the buffered/visited copy first)
         vals = jnp.concatenate([buf_v, jnp.where(nbrs < 0, worst, dist)], axis=1)
         ids = jnp.concatenate([buf_i, nbrs], axis=1)
         flg = jnp.concatenate([buf_f, jnp.zeros(nbrs.shape, bool)], axis=1)
@@ -541,10 +568,16 @@ def _cagra_search_impl(
         out_i = jnp.take_along_axis(ids, pos, axis=1)
         out_f = jnp.take_along_axis(flg, pos, axis=1)
         out_i = jnp.where(out_v == worst, -1, out_i)
+        if dedup == "post":
+            prev = jnp.concatenate([jnp.full_like(out_i[:, :1], -2), out_i[:, :-1]], axis=1)
+            dup = (out_i == prev) & (out_i >= 0)
+            out_v = jnp.where(dup, worst, out_v)
+            out_i = jnp.where(dup, -1, out_i)
+            out_f = jnp.where(dup, True, out_f)  # dead slots never parent
         return out_v, out_i, out_f
 
     buf_v, buf_i, buf_f = lax.fori_loop(0, iters, body, (buf_v, buf_i, buf_f))
-    if not dedup:
+    if dedup == "none":
         # one final sort-dedup so duplicate ids cannot occupy several of
         # the returned top-k slots
         buf_v, buf_i, buf_f = running_merge_unique(
@@ -604,6 +637,10 @@ def search(
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "bad query shape")
     expects(k >= 1, "k must be >= 1")
+    expects(
+        params.dedup in ("sort", "post", "none", True, False),
+        "dedup must be sort|post|none, got %r", params.dedup,
+    )
     # auto iteration count (search_plan.cuh:136 adjust_search_params)
     itopk, width, iters, n_init = derive_search_config(params, k, index.size)
     if prefilter is not None:
@@ -652,7 +689,7 @@ def search(
             metric=index.metric,
             has_filter=filter_bits is not None,
             use_vpq=use_vpq,
-            dedup=params.dedup,
+            dedup={True: "sort", False: "none"}.get(params.dedup, params.dedup),
         )
         if bpad:
             v, i = v[:-bpad], i[:-bpad]

@@ -734,8 +734,6 @@ def _ivf_pq_scan_impl(
     formulation on TPU v5e.
     """
     nq, d = queries.shape
-    n_lists, max_list, pq_dim = codes.shape
-    ksub = pq_centers.shape[-2]
     qf = queries.astype(jnp.float32)
 
     # coarse scores double as the probe selector AND the q.c_l term
@@ -745,6 +743,7 @@ def _ivf_pq_scan_impl(
     else:
         c_norm = jnp.sum(centers * centers, axis=1)
         coarse = c_norm[None, :] - 2.0 * q_dot_c
+    n_lists = centers.shape[0]
     probed = jnp.zeros((nq, n_lists), bool)
     if n_probes < n_lists:
         _, probes = select_k(coarse, n_probes, select_min=True)
@@ -753,6 +752,44 @@ def _ivf_pq_scan_impl(
         probed = jnp.ones((nq, n_lists), bool)
 
     q_rot = qf @ rotation.T  # [nq, rot_dim]
+    return pq_scan_core(
+        pq_centers, codes, list_indices, rot_sqnorms, q_rot, q_dot_c,
+        probed, filter_bits,
+        k=k, metric=metric, per_cluster=per_cluster, has_filter=has_filter,
+        chunk_lists=chunk_lists, bf16=bf16,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "per_cluster", "has_filter", "chunk_lists", "bf16"),
+)
+def pq_scan_core(
+    pq_centers,
+    codes,
+    list_indices,
+    rot_sqnorms,
+    q_rot,
+    q_dot_c,
+    probed,
+    filter_bits,
+    *,
+    k: int,
+    metric: DistanceType,
+    per_cluster: bool,
+    has_filter: bool,
+    chunk_lists: int,
+    bf16: bool,
+):
+    """Decode-and-score over a (possibly LOCAL slice of the) list set with
+    a precomputed probe mask — the shardable core of the dense PQ scan,
+    mirroring :func:`raft_tpu.neighbors.ivf_flat.flat_scan_core`:
+    ``codes/list_indices/rot_sqnorms/q_dot_c/probed`` may all be sliced to
+    a shard's lists (list_indices carry GLOBAL row ids, so per-shard
+    results merge with one allgather + k-way merge)."""
+    nq = q_rot.shape[0]
+    n_lists, max_list, pq_dim = codes.shape
+    ksub = pq_centers.shape[-2]
     rot_dim = q_rot.shape[1]
 
     cdtype = jnp.bfloat16 if bf16 else jnp.float32

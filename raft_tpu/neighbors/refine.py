@@ -26,9 +26,14 @@ def refine(
     k: int,
     metric=DistanceType.L2SqrtExpanded,
     metric_arg: float = 2.0,
+    query_batch: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Re-rank ``candidates`` [n_queries, n_cand] (i32 ids into ``dataset``,
     -1 = invalid) down to the top ``k`` by exact distance.
+
+    ``query_batch``: 0 = auto — cap the gathered [batch, n_cand, dim] f32
+    temporary at ~1 GB (CAGRA's graph build refines the WHOLE dataset as
+    queries; unbatched that would allocate n * n_cand * dim * 4 bytes).
 
     Returns ``(distances [n_queries, k], indices [n_queries, k])``.
     """
@@ -40,6 +45,28 @@ def refine(
     expects(candidates.shape[0] == queries.shape[0], "queries/candidates row mismatch")
     n_cand = candidates.shape[1]
     expects(0 < k <= n_cand, "k=%d out of range for %d candidates", k, n_cand)
+
+    nq = queries.shape[0]
+    if query_batch <= 0:
+        per_q = max(1, n_cand * dataset.shape[1] * 4)
+        query_batch = max(256, (1 << 30) // per_q)
+    if nq > query_batch:
+        out_v, out_i = [], []
+        for s in range(0, nq, query_batch):
+            cnt = min(query_batch, nq - s)
+            if cnt < query_batch:  # pad the tail to keep one compiled shape
+                q = jnp.pad(queries[s : s + cnt], ((0, query_batch - cnt), (0, 0)))
+                c = jnp.pad(
+                    candidates[s : s + cnt],
+                    ((0, query_batch - cnt), (0, 0)),
+                    constant_values=-1,
+                )
+            else:
+                q, c = queries[s : s + cnt], candidates[s : s + cnt]
+            v, i = refine(dataset, q, c, k, metric, metric_arg, query_batch)
+            out_v.append(v[:cnt])
+            out_i.append(i[:cnt])
+        return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
 
     valid = candidates >= 0
     safe_ids = jnp.where(valid, candidates, 0)

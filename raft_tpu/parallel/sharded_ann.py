@@ -11,10 +11,17 @@ structures scale:
   (:func:`raft_tpu.neighbors.ivf_flat.flat_scan_core`) — list ids in the
   padded layout are global dataset row ids, so per-shard top-k merge with
   one ``all_gather`` + k-way merge (``knn_merge_parts`` pattern).
-* **CAGRA / IVF-PQ: queries sharded, index replicated** — graph beam
-  search is latency-bound per query and the graph is compact, so
-  replicated-index data parallelism is the first-order scaling knob (the
-  reference's multi-GPU story for CAGRA is likewise index-replica
+* **IVF-PQ: inverted lists sharded** (round 4): replicated coarse centers
+  + quantizers (tiny), each shard decode-scans only ITS slice of the code
+  lists (:func:`raft_tpu.neighbors.ivf_pq.pq_scan_core`), allgather +
+  k-way merge — the compressed analog of the IVF-Flat sharding, and the
+  path that takes DEEP-100M-class datasets past one chip's HBM.
+  :func:`sharded_ivf_pq_build` is the matching distributed-build sketch
+  (psum-Lloyd coarse centers + codebooks over row-sharded data).
+* **CAGRA / IVF-PQ (small indexes): queries sharded, index replicated** —
+  graph beam search is latency-bound per query and the graph is compact,
+  so replicated-index data parallelism is the first-order scaling knob
+  (the reference's multi-GPU story for CAGRA is likewise index-replica
   sharding at the serving layer).
 
 Everything runs under ``shard_map`` over a :func:`make_mesh` mesh and
@@ -193,6 +200,223 @@ def sharded_cagra_search(
 
 
 @functools.lru_cache(maxsize=64)
+def _ivf_pq_lists_fn(mesh, axis, k, n_probes, metric, g, bf16, l_local):
+    """Lists-sharded PQ search program: replicated centers/quantizers,
+    per-shard decode scan over the local list slice, allgather + merge."""
+
+    def local(centers, centers_rot, rotation, pq_centers, codes, li, sqn, q):
+        rank = lax.axis_index(axis)
+        qf = q.astype(jnp.float32)
+        q_dot_c = qf @ centers.T
+        if metric == DistanceType.InnerProduct:
+            coarse = -q_dot_c
+        else:
+            c_norm = jnp.sum(centers * centers, axis=1)
+            coarse = c_norm[None, :] - 2.0 * q_dot_c
+        nq = q.shape[0]
+        n_lists = centers.shape[0]
+        from raft_tpu.ops.select_k import select_k as _sk
+
+        probed = jnp.zeros((nq, n_lists), bool)
+        if n_probes < n_lists:
+            _, probes = _sk(coarse, n_probes, select_min=True)
+            probed = probed.at[jnp.arange(nq)[:, None], probes].set(True)
+        else:
+            probed = jnp.ones((nq, n_lists), bool)
+        probed_l = lax.dynamic_slice_in_dim(probed, rank * l_local, l_local, axis=1)
+        qdc_l = lax.dynamic_slice_in_dim(q_dot_c, rank * l_local, l_local, axis=1)
+        q_rot = qf @ rotation.T
+        v, i = ivf_pq_mod.pq_scan_core(
+            pq_centers, codes, li, sqn, q_rot, qdc_l, probed_l, None,
+            k=k, metric=metric, per_cluster=False, has_filter=False,
+            chunk_lists=g, bf16=bf16,
+        )
+        all_v = jax.lax.all_gather(v, axis)
+        all_i = jax.lax.all_gather(i, axis)
+        cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
+        cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
+        select_min = metric != DistanceType.InnerProduct
+        return merge_parts(cat_v, cat_i, k, select_min=select_min)
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+def sharded_ivf_pq_lists_search(
+    mesh: Mesh,
+    index: "ivf_pq_mod.IvfPqIndex",
+    queries,
+    k: int,
+    params: Optional["ivf_pq_mod.IvfPqSearchParams"] = None,
+    axis: str = "data",
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """IVF-PQ search with the CODE LISTS sharded over ``mesh`` axis
+    ``axis`` (replicated coarse centers + codebooks). Per-shard HBM holds
+    ``1/n_shards`` of the codes — the scaling mode for datasets beyond one
+    chip (SURVEY §7 step 7). Returns replicated ``(distances, indices)``
+    from the same probed candidate set as single-device scan search."""
+    if params is None:
+        params = ivf_pq_mod.IvfPqSearchParams(**kwargs)
+    expects(
+        index.codebook_kind == ivf_pq_mod.PER_SUBSPACE,
+        "lists-sharded PQ needs per_subspace codebooks (per_cluster books would shard too)",
+    )
+    queries = jnp.asarray(queries, jnp.float32)
+    n_shards = mesh.shape[axis]
+    L = index.n_lists
+    expects(L % n_shards == 0, "n_lists %d not divisible by %d shards", L, n_shards)
+    l_local = L // n_shards
+    n_probes = min(params.n_probes, L)
+    g = ivf_pq_mod.scan_chunk_lists(l_local, index.max_list)
+    bf16 = ivf_pq_mod.scan_bf16(params.lut_dtype)
+
+    fn = _ivf_pq_lists_fn(mesh, axis, k, n_probes, index.metric, g, bf16, l_local)
+    put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    return fn(
+        put(index.centers, P()),
+        put(index.centers_rot, P()),
+        put(index.rotation, P()),
+        put(index.pq_centers, P()),
+        put(index.codes_unpacked(), P(axis)),
+        put(index.list_indices, P(axis)),
+        put(index.rot_sqnorms, P(axis)),
+        put(queries, P()),
+    )
+
+
+def sharded_ivf_pq_build(
+    mesh: Mesh,
+    dataset,
+    params: Optional["ivf_pq_mod.IvfPqIndexParams"] = None,
+    axis: str = "data",
+    **kwargs,
+) -> "ivf_pq_mod.IvfPqIndex":
+    """Distributed IVF-PQ build sketch (SURVEY §7 step 7): dataset rows
+    sharded over the mesh, coarse centers and per-subspace codebooks
+    trained with psum-Lloyd (local assign + summed center updates — the
+    allreduce pattern of ``cluster/detail/kmeans_balanced.cuh`` scaled
+    out), then every shard encodes its rows locally and the packed lists
+    are assembled. The returned index is replicated (at DCN scale the
+    final allgather would be skipped and the lists kept sharded for
+    :func:`sharded_ivf_pq_lists_search`)."""
+    if params is None:
+        params = ivf_pq_mod.IvfPqIndexParams(**kwargs)
+    dataset = jnp.asarray(dataset, jnp.float32)
+    n, d = dataset.shape
+    n_shards = mesh.shape[axis]
+    expects(n % n_shards == 0, "rows %d not divisible by %d shards", n, n_shards)
+    n_lists = min(params.n_lists, n)
+    pq_dim = params.pq_dim or ivf_pq_mod._default_pq_dim(d)
+    rot_dim = ((d + pq_dim - 1) // pq_dim) * pq_dim
+    ksub = 1 << params.pq_bits
+
+    key = as_key(params.seed)
+    k_init, k_rot = jax.random.split(key)
+    init_centers = dataset[jax.random.permutation(k_init, n)[:n_lists]]
+    rotation = ivf_pq_mod._make_rotation(k_rot, rot_dim, d, params.force_random_rotation)
+
+    def lloyd_step(centers, x_local):
+        # local fused assign + psum'd center update (one allreduce per iter)
+        d2 = (
+            jnp.sum(x_local * x_local, axis=1)[:, None]
+            - 2.0 * x_local @ centers.T
+            + jnp.sum(centers * centers, axis=1)[None, :]
+        )
+        lab = jnp.argmin(d2, axis=1)
+        sums = jax.ops.segment_sum(x_local, lab, num_segments=n_lists)
+        cnts = jax.ops.segment_sum(jnp.ones_like(lab, jnp.float32), lab, num_segments=n_lists)
+        sums = lax.psum(sums, axis)
+        cnts = lax.psum(cnts, axis)
+        new = sums / jnp.maximum(cnts[:, None], 1e-9)
+        return jnp.where(cnts[:, None] > 0, new, centers), lab
+
+    def train(x_local, centers0):
+        centers = centers0
+        for _ in range(params.kmeans_n_iters):
+            centers, _ = lloyd_step(centers, x_local)
+        _, lab = lloyd_step(centers, x_local)
+        # per-subspace codebooks on local residuals, psum'd updates;
+        # seeded from rank 0's first ksub residual rows (a real-data init —
+        # random gaussians collapse to few used centers)
+        resid = ((x_local - centers[lab]) @ rotation.T).reshape(x_local.shape[0], pq_dim, -1)
+        n_seed = min(ksub, resid.shape[0])
+        seed = lax.psum(
+            jnp.where(lax.axis_index(axis) == 0, 1.0, 0.0) * resid[:n_seed], axis
+        )  # [n_seed, pq_dim, pq_len]
+        books = jnp.transpose(seed, (1, 0, 2))
+        if n_seed < ksub:
+            reps = -(-ksub // n_seed)
+            books = jnp.tile(books, (1, reps, 1))[:, :ksub, :]
+
+        def cb_step(books):
+            dots = jnp.einsum("npl,pkl->npk", resid, books, preferred_element_type=jnp.float32)
+            cn = jnp.sum(books * books, axis=-1)[None, :, :]
+            code = jnp.argmin(cn - 2.0 * dots, axis=-1)  # [nl, pq_dim]
+            oh = jax.nn.one_hot(code, ksub, dtype=jnp.float32)  # [nl, pq_dim, ksub]
+            sums = jnp.einsum("npk,npl->pkl", oh, resid)
+            cnts = jnp.sum(oh, axis=0)  # [pq_dim, ksub]
+            sums = lax.psum(sums, axis)
+            cnts = lax.psum(cnts, axis)
+            new = sums / jnp.maximum(cnts[..., None], 1e-9)
+            return jnp.where(cnts[..., None] > 0, new, books)
+
+        for _ in range(max(4, params.kmeans_n_iters)):
+            books = cb_step(books)
+        return centers, books
+
+    fn = jax.jit(
+        shard_map(
+            train,
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+    put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    centers, books = fn(put(dataset, P(axis)), put(init_centers, P()))
+
+    # local encode + pack (replicated assembly; kept sharded at DCN scale)
+    from raft_tpu.neighbors import ivf_common
+
+    cand = ivf_common.topk_labels(dataset, centers, k=8)
+    max_list = ivf_common.choose_max_list(cand[:, 0], n, n_lists, params.list_cap_factor)
+    slot = ivf_common.assign_slots(cand, n_lists=n_lists, max_list=max_list)
+    final_labels = (slot // max_list).astype(jnp.int32)
+    codes_rows = ivf_pq_mod._encode_all(
+        dataset, final_labels, centers, rotation, books, pq_dim, False
+    )
+    codes, list_indices, list_sizes = ivf_common.scatter_rows(
+        codes_rows, jnp.arange(n, dtype=jnp.int32), slot, n_lists=n_lists, max_list=max_list
+    )
+    centers_rot = centers @ rotation.T
+    return ivf_pq_mod.IvfPqIndex(
+        centers=centers,
+        centers_rot=centers_rot,
+        rotation=rotation,
+        pq_centers=books,
+        codes=codes,
+        list_indices=list_indices,
+        list_sizes=list_sizes,
+        rot_sqnorms=ivf_pq_mod._sqnorms_for(codes, centers_rot, books, False),
+        metric=ivf_pq_mod.resolve_metric(params.metric),
+        codebook_kind=ivf_pq_mod.PER_SUBSPACE,
+        pq_bits=params.pq_bits,
+        size=n,
+        list_cap_factor=params.list_cap_factor,
+        center_rank=None,
+    )
+
+
+@functools.lru_cache(maxsize=64)
 def _ivf_pq_fn(mesh, axis, k, n_probes, metric, per_cluster, g, bf16):
     def local(centers, centers_rot, rotation, pq_centers, codes, li, sqn, q):
         return ivf_pq_mod._ivf_pq_scan_impl(
@@ -243,7 +467,7 @@ def sharded_ivf_pq_search(
         put(index.centers_rot, P()),
         put(index.rotation, P()),
         put(index.pq_centers, P()),
-        put(index.codes, P()),
+        put(index.codes_unpacked(), P()),
         put(index.list_indices, P()),
         put(index.rot_sqnorms, P()),
         put(queries, P(axis)),

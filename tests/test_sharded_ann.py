@@ -88,6 +88,46 @@ class TestShardedIvfPq:
         rec = float(neighborhood_recall(np.asarray(si), np.asarray(ui)))
         assert rec >= 0.99, rec
 
+    def test_lists_sharded_matches_unsharded(self, setup):
+        """VERDICT r3 item 6: inverted code lists sharded across the mesh
+        (per-shard HBM holds 1/n of the codes), replicated quantizers."""
+        from raft_tpu.parallel.sharded_ann import sharded_ivf_pq_lists_search
+
+        mesh, X, Q = setup
+        k = 10
+        index = ivf_pq.build(X, ivf_pq.IvfPqIndexParams(n_lists=64, pq_dim=8, seed=2))
+        sv, si = sharded_ivf_pq_lists_search(mesh, index, Q, k, n_probes=32)
+        uv, ui = ivf_pq.search(index, Q, k, ivf_pq.IvfPqSearchParams(n_probes=32), mode="scan")
+        rec = float(neighborhood_recall(np.asarray(si), np.asarray(ui)))
+        assert rec >= 0.97, rec
+
+    def test_lists_sharded_packed_codes(self, setup):
+        from raft_tpu.parallel.sharded_ann import sharded_ivf_pq_lists_search
+
+        mesh, X, Q = setup
+        k = 5
+        index = ivf_pq.build(X, ivf_pq.IvfPqIndexParams(n_lists=64, pq_dim=8, pq_bits=4, seed=2))
+        assert index.packed
+        _, si = sharded_ivf_pq_lists_search(mesh, index, Q, k, n_probes=32)
+        _, ui = ivf_pq.search(index, Q, k, ivf_pq.IvfPqSearchParams(n_probes=32), mode="scan")
+        rec = float(neighborhood_recall(np.asarray(si), np.asarray(ui)))
+        assert rec >= 0.95, rec
+
+    def test_distributed_build_sketch(self, setup):
+        """psum-Lloyd coarse + codebook training over row-sharded data."""
+        from raft_tpu.parallel.sharded_ann import sharded_ivf_pq_build
+
+        mesh, X, Q = setup
+        k = 5
+        index = sharded_ivf_pq_build(
+            mesh, X, ivf_pq.IvfPqIndexParams(n_lists=32, pq_dim=8, kmeans_n_iters=5, seed=2)
+        )
+        _, si = ivf_pq.search(index, Q, k, ivf_pq.IvfPqSearchParams(n_probes=16), mode="scan")
+        bf = brute_force.build(X, metric=DistanceType.L2Expanded)
+        _, gt = brute_force.search(bf, Q, k)
+        rec = float(neighborhood_recall(np.asarray(si), np.asarray(gt)))
+        assert rec >= 0.5, rec  # quantized ADC on a sketch build: loose floor
+
 
 class TestShardedCagraVpq:
     def test_vpq_index_works_sharded(self, setup):

@@ -61,13 +61,13 @@ def main():
 
     print(f"# {'config':44s} {'qps':>10s} {'recall':>8s}")
     for itopk, w, dedup in [
-        (128, 4, True),
-        (128, 4, False),
-        (160, 4, False),
-        (192, 4, False),
-        (128, 8, False),
-        (192, 8, False),
-        (64, 4, False),
+        (128, 4, "sort"),
+        (128, 4, "post"),
+        (160, 4, "post"),
+        (96, 4, "post"),
+        (64, 4, "post"),
+        (128, 8, "post"),
+        (64, 2, "post"),
     ]:
         sp = cagra.CagraSearchParams(itopk_size=itopk, search_width=w, dedup=dedup)
         tag = f"itopk={itopk} w={w} dedup={dedup}"
