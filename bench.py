@@ -165,6 +165,13 @@ def main():
         print(f"# {algo:16s} {config:40s} {nq/dt:>12,.0f} qps  recall={results[algo][-1]['recall']:.4f}",
               flush=True)
 
+    # Global wall-clock guard: each phase checks it so the bench ALWAYS
+    # finishes within the driver's budget even under bad tenancy.
+    budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", 2400))
+
+    def over_budget(frac=1.0):
+        return time.perf_counter() - t_all > budget_s * frac
+
     build_times = {"brute_force": 0.0}
     record("brute_force_exact", "tile=262144", t_exact, ei)
 
@@ -187,7 +194,6 @@ def main():
         (30, 32, 8, "bank8"),
         (20, 32, 8, "bank8"),
         (30, 32, 16, "bank8"),
-        (50, 32, 8, "bank8"),
     ):
         sp = ivf_flat.IvfFlatSearchParams(
             n_probes=npr, fused_qt=128, fused_probe_factor=pf, fused_group=g,
@@ -199,35 +205,37 @@ def main():
         record("ivf_flat", f"fused bf16 npr={npr} pf={pf} G={g} {merge}", dt, i)
 
     # ---- IVF-PQ: fused Pallas scan, additive nibble codebooks ------------
-    t0 = time.perf_counter()
-    pidx = ivf_pq.build(
-        dataset,
-        ivf_pq.IvfPqIndexParams(
-            n_lists=1024, pq_dim=32, pq_bits=8, pq_kind="nibble",
-            kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
-        ),
-    )
-    float(jnp.sum(pidx.list_sizes))
-    build_times["ivf_pq"] = round(time.perf_counter() - t0, 1)
-    code_mb = round(pidx.codes.size / 1e6, 1)
+    pidx = None
+    if over_budget(0.5):
+        print("# ivf_pq skipped: time budget", flush=True)
+    else:
+        t0 = time.perf_counter()
+        pidx = ivf_pq.build(
+            dataset,
+            ivf_pq.IvfPqIndexParams(
+                n_lists=1024, pq_dim=32, pq_bits=8, pq_kind="nibble",
+                kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
+            ),
+        )
+        float(jnp.sum(pidx.list_sizes))
+        build_times["ivf_pq"] = round(time.perf_counter() - t0, 1)
+        code_mb = round(pidx.codes.size / 1e6, 1)
 
-    sp30 = ivf_pq.IvfPqSearchParams(n_probes=30, fused_probe_factor=32, fused_group=8)
-    dt, (v, i) = _timed(lambda: ivf_pq.search(pidx, queries, K, sp30, mode="fused"), nrep=2)
-    record("ivf_pq", f"fused nib32 npr=30 ({code_mb}MB codes)", dt, i)
+        sp30 = ivf_pq.IvfPqSearchParams(n_probes=30, fused_probe_factor=32, fused_group=8)
+        dt, (v, i) = _timed(lambda: ivf_pq.search(pidx, queries, K, sp30, mode="fused"), nrep=2)
+        record("ivf_pq", f"fused nib32 npr=30 ({code_mb}MB codes)", dt, i)
 
-    def pq_refined(sp, rr):
-        _, cand = ivf_pq.search(pidx, queries, rr * K, sp, mode="fused")
-        return refine(dataset, queries, cand, K, metric=DistanceType.L2Expanded)
+        def pq_refined(sp, rr):
+            _, cand = ivf_pq.search(pidx, queries, rr * K, sp, mode="fused")
+            return refine(dataset, queries, cand, K, metric=DistanceType.L2Expanded)
 
-    for npr, rr in ((30, 8), (50, 8)):
-        sp = ivf_pq.IvfPqSearchParams(n_probes=npr, fused_probe_factor=32, fused_group=8)
-        dt, (v, i) = _timed(lambda sp=sp, rr=rr: pq_refined(sp, rr), nrep=2)
-        record("ivf_pq", f"fused nib32 npr={npr} refine={rr}x", dt, i)
+        sp = ivf_pq.IvfPqSearchParams(n_probes=30, fused_probe_factor=32, fused_group=8)
+        dt, (v, i) = _timed(lambda: pq_refined(sp, 8), nrep=2)
+        record("ivf_pq", "fused nib32 npr=30 refine=8x", dt, i)
 
-    # ---- CAGRA: ivf_pq-path graph build + no-dedup beam ------------------
+    # ---- CAGRA: ivf_pq-path graph build (reusing the bench's PQ index) ---
     cagra_err = None
-    budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", 2400))
-    if time.perf_counter() - t_all > budget_s:
+    if over_budget(0.6) or pidx is None:
         cagra_err = "skipped: time budget exhausted before CAGRA build"
         print(f"# {cagra_err}", flush=True)
     try:
@@ -239,10 +247,11 @@ def main():
             cagra.CagraIndexParams(
                 intermediate_graph_degree=32, graph_degree=16, build_algo=cagra.IVF_PQ
             ),
+            pq_index=pidx,
         )
         float(jnp.sum(cidx.graph[0].astype(jnp.float32)))
         build_times["cagra"] = round(time.perf_counter() - t0, 1)
-        for itopk, w, dd in ((160, 4, False), (128, 4, False), (192, 8, False)):
+        for itopk, w, dd in ((128, 4, "post"), (160, 4, "post")):
             dt, (v, i) = _timed(
                 lambda itopk=itopk, w=w, dd=dd: cagra.search(
                     cidx, queries, K,

@@ -24,13 +24,14 @@ from raft_tpu.stats import neighborhood_recall
 
 def main():
     print(f"devices: {jax.devices()}")
-    ds = make_clustered("example", n=30_000, dim=64, n_queries=256, seed=7)
+    ds = make_clustered("example", n=8_000, dim=64, n_queries=256, seed=7)
     k = 10
 
     # --- build (cagra_example.cu: index_params + build) --------------------
     # NN_DESCENT for small data; IVF_PQ is the fast path at 1M+ scale.
     params = cagra.CagraIndexParams(
-        intermediate_graph_degree=48, graph_degree=24, build_algo=cagra.NN_DESCENT
+        intermediate_graph_degree=32, graph_degree=16, build_algo=cagra.NN_DESCENT,
+        nn_descent_niter=10,
     )
     index = cagra.build(ds.base, params)
     print(f"built CAGRA: n={index.size} graph_degree={index.graph_degree}")

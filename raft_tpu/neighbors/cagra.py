@@ -291,11 +291,15 @@ def build(
     dataset,
     params: Optional[CagraIndexParams] = None,
     res: Optional[Resources] = None,
+    pq_index=None,
     **kwargs,
 ) -> CagraIndex:
     """Build the CAGRA index (``cagra::build``, ``cagra_build.cuh:293``):
     intermediate kNN graph via NN-descent or IVF-PQ+refine, then
-    :func:`optimize`."""
+    :func:`optimize`. ``pq_index``: an already-built
+    :class:`~raft_tpu.neighbors.ivf_pq.IvfPqIndex` over this dataset to
+    reuse for the ``build_algo="ivf_pq"`` path (skips the internal PQ
+    build — callers that serve both indexes build once)."""
     res = ensure_resources(res)
     if params is None:
         params = CagraIndexParams(**kwargs)
@@ -336,22 +340,26 @@ def build(
         from raft_tpu.core.logging import logger
 
         t0 = _time.perf_counter()
-        pq = ivf_pq_mod.build(
-            dataset,
-            ivf_pq_mod.IvfPqIndexParams(
-                n_lists=max(1, min(1024, n // 128)),
-                metric=metric,
-                seed=params.seed,
-                # pq_dim 32 keeps the fused decode LUT small (K = 32*32
-                # columns); graph-build shortlists only need coarse
-                # ranking, the exact refine below restores order
-                pq_dim=32 if d >= 64 and d % 32 == 0 else 0,
-                pq_kind="nibble",
-                kmeans_n_iters=10,
-                kmeans_trainset_fraction=min(1.0, max(0.05, 100_000 / max(n, 1))),
-                list_cap_factor=1.1,
-            ),
-        )
+        if pq_index is not None:
+            expects(pq_index.size == n, "pq_index covers %d rows, dataset has %d", pq_index.size, n)
+            pq = pq_index
+        else:
+            pq = ivf_pq_mod.build(
+                dataset,
+                ivf_pq_mod.IvfPqIndexParams(
+                    n_lists=max(1, min(1024, n // 128)),
+                    metric=metric,
+                    seed=params.seed,
+                    # pq_dim 32 keeps the fused decode LUT small (K = 32*32
+                    # columns); graph-build shortlists only need coarse
+                    # ranking, the exact refine below restores order
+                    pq_dim=32 if d >= 64 and d % 32 == 0 else 0,
+                    pq_kind="nibble",
+                    kmeans_n_iters=10,
+                    kmeans_trainset_fraction=min(1.0, max(0.05, 100_000 / max(n, 1))),
+                    list_cap_factor=1.1,
+                ),
+            )
         jax.block_until_ready(pq.codes)
         t1 = _time.perf_counter()
         top = kin + 1
