@@ -1,0 +1,61 @@
+"""data_export CSV + recall/QPS plot — raft-ann-bench L8 parity
+(``raft_ann_bench/data_export/__main__.py``, ``plot/__main__.py`` analogs).
+"""
+import csv
+import json
+import os
+
+from raft_tpu.bench.data_export import export_csv
+from raft_tpu.bench.plot import _frontier, plot_report
+
+
+def _report():
+    return {
+        "context": {"device": "cpu-test"},
+        "benchmarks": [
+            {
+                "name": f"ivf_flat/npr={p}",
+                "algo": "ivf_flat",
+                "dataset": "unit",
+                "k": 10,
+                "n_queries": 64,
+                "Recall": r,
+                "items_per_second": q,
+                "Latency": 0.001,
+                "end_to_end": 0.01,
+                "build_time": 1.0,
+                "build_params": {"n_lists": 16},
+                "search_params": {"n_probes": p},
+            }
+            for p, r, q in [(4, 0.8, 1000.0), (8, 0.9, 700.0), (16, 0.97, 400.0), (8, 0.85, 300.0)]
+        ],
+    }
+
+
+def test_export_csv_round_trip(tmp_path):
+    out = export_csv(_report(), str(tmp_path / "res.csv"))
+    with open(out) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 4
+    assert rows[0]["algo"] == "ivf_flat"
+    assert float(rows[2]["recall"]) == 0.97
+    assert json.loads(rows[0]["search_params"]) == {"n_probes": 4}
+
+
+def test_export_csv_from_json_file(tmp_path):
+    p = tmp_path / "rep.json"
+    p.write_text(json.dumps(_report()))
+    out = export_csv(str(p), str(tmp_path / "res.csv"))
+    assert os.path.exists(out)
+
+
+def test_pareto_frontier_shape():
+    pts = [(0.8, 1000.0), (0.9, 700.0), (0.97, 400.0), (0.85, 300.0)]
+    fr = _frontier(pts)
+    # (0.85, 300) is dominated by (0.9, 700); the rest survive
+    assert fr == [(0.8, 1000.0), (0.9, 700.0), (0.97, 400.0)]
+
+
+def test_plot_writes_png(tmp_path):
+    out = plot_report(_report(), str(tmp_path / "plot.png"), title="unit")
+    assert os.path.exists(out) and os.path.getsize(out) > 1000
