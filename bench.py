@@ -49,6 +49,8 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
 N, D, NQ, K = 1_000_000, 128, 1024, 10
 N_CENTERS = 1000
+if os.environ.get("RAFT_TPU_BENCH_SMOKE"):  # tiny code-path check (CI/CPU)
+    N, D, NQ, N_CENTERS = 20_000, 64, 256, 50
 CLUSTER_STD = 1.0  # same scale as the center spread: overlapping clusters
 #   (SIFT-like). Tighter blobs make graph traversal between clusters
 #   artificially impossible and every IVF probe artificially perfect.
