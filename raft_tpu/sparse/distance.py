@@ -208,10 +208,13 @@ def knn_sparse(
     metric=DistanceType.L2Expanded,
     metric_arg: float = 2.0,
     block: int = 1024,
+    mode: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Sparse brute-force kNN (``sparse/neighbors/brute_force.cuh``):
     block distances + running top-k merge. Returns (dists, ids) of y-rows
-    nearest to each x-row."""
+    nearest to each x-row. ``mode`` as in :func:`pairwise_distance_sparse`
+    — ``"native"`` (or auto on very wide matrices) computes distances from
+    the sort-merge gram without densifying the feature axis."""
     metric = resolve_metric(metric)
     from raft_tpu.ops.distance import is_min_close
 
@@ -220,6 +223,11 @@ def knn_sparse(
     m = x.shape[0]
     expects(0 < k <= n, "k out of range")
     worst = jnp.float32(worst_value(jnp.float32, select_min))
+
+    expects(mode in ("auto", "densify", "native"), "bad mode %r", mode)
+    if mode == "native" or (mode == "auto" and x.shape[1] > (1 << 18) and metric in _NATIVE):
+        d = pairwise_distance_sparse_native(x, y, metric)
+        return select_k(d, k, select_min=select_min)
 
     x_rows = x.row_ids()
     y_rows = y.row_ids()

@@ -280,3 +280,12 @@ class TestSolvers:
         assert len(src) == 2
         assert labels[src[0]] != labels[dst[0]]
         assert labels[src[1]] != labels[dst[1]]
+
+
+class TestSparseKnnNative:
+    def test_native_knn_matches_densify(self, rng):
+        xd = (rng.random((40, 30)) * (rng.random((40, 30)) < 0.4)).astype(np.float32)
+        x = sparse.csr_from_dense(xd)
+        dn, i_n = sparse.knn_sparse(x, x, 5, mode="native")
+        dd, i_d = sparse.knn_sparse(x, x, 5, mode="densify")
+        np.testing.assert_allclose(np.asarray(dn), np.asarray(dd), rtol=1e-4, atol=1e-5)
