@@ -1,0 +1,9 @@
+"""Native (C) runtime components, compiled on demand and bound via ctypes.
+
+The reference ships its runtime layer as C++/CUDA; here the TPU compute
+path is JAX/Pallas and the host-side native pieces live in this package:
+small C sources compiled once with the system compiler into a per-user
+cache (no pybind11 — plain ``ctypes`` over a C ABI), with pure-Python
+fallbacks when no compiler is available.
+"""
+from raft_tpu.native.build import load_native  # noqa: F401

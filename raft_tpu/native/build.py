@@ -1,0 +1,72 @@
+"""On-demand compilation + ctypes loading of the native C components.
+
+One ``cc -O3 -shared -fPIC`` per source, cached under
+``~/.cache/raft_tpu_native`` keyed by source hash — the moral equivalent
+of the reference's precompiled ``libraft.so`` (``cpp/CMakeLists.txt:584``)
+at the scale this framework needs native host code. Thread-safe,
+fallback-friendly: callers treat a ``None`` return as "use the Python
+path".
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+_CACHE_DIR = os.path.join(
+    os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "raft_tpu_native"
+)
+_LOCK = threading.Lock()
+_LOADED: dict = {}
+
+
+def _compiler() -> Optional[str]:
+    for cand in (os.environ.get("CC"), sysconfig.get_config_var("CC"), "cc", "gcc", "clang"):
+        if not cand:
+            continue
+        exe = cand.split()[0]
+        from shutil import which
+
+        if which(exe):
+            return cand
+    return None
+
+
+def load_native(name: str) -> Optional[ctypes.CDLL]:
+    """Compile (once) and load ``raft_tpu/native/<name>.c``; ``None`` if no
+    compiler is available or compilation fails."""
+    with _LOCK:
+        if name in _LOADED:
+            return _LOADED[name]
+        src = os.path.join(os.path.dirname(__file__), f"{name}.c")
+        try:
+            with open(src, "rb") as f:
+                code = f.read()
+        except OSError:
+            _LOADED[name] = None
+            return None
+        tag = hashlib.sha256(code).hexdigest()[:16]
+        out = os.path.join(_CACHE_DIR, f"{name}-{tag}.so")
+        if not os.path.exists(out):
+            cc = _compiler()
+            if cc is None:
+                _LOADED[name] = None
+                return None
+            os.makedirs(_CACHE_DIR, exist_ok=True)
+            tmp = out + f".tmp{os.getpid()}"
+            cmd = cc.split() + ["-O3", "-shared", "-fPIC", "-o", tmp, src]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                os.replace(tmp, out)
+            except (subprocess.SubprocessError, OSError):
+                _LOADED[name] = None
+                return None
+        try:
+            _LOADED[name] = ctypes.CDLL(out)
+        except OSError:
+            _LOADED[name] = None
+        return _LOADED[name]

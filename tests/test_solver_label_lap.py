@@ -1,0 +1,23 @@
+
+
+def test_lap_native_matches_python_fallback():
+    """The C solver (raft_tpu/native/lap.c) and the numpy fallback find
+    assignments with the same optimal cost."""
+    import numpy as np
+    from raft_tpu.solver import lap as lap_mod
+
+    rng = np.random.default_rng(11)
+    c = rng.random((64, 64))
+    native = lap_mod._native_solve(np.asarray(c, np.float64))
+    if native is None:  # no compiler in this environment
+        import pytest
+
+        pytest.skip("no C compiler for the native path")
+    r_n, _, t_n = native
+    # force the pure-python path by bypassing the native branch
+    import unittest.mock as mock
+
+    with mock.patch.object(lap_mod, "_native_solve", lambda _c: None):
+        r_p, _, t_p = lap_mod.lap_solve(c)
+    assert abs(t_n - t_p) < 1e-9
+    assert sorted(r_n.tolist()) == list(range(64))
