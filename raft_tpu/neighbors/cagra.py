@@ -212,6 +212,10 @@ def _detour_rerank_chunk(graph, chunk_ids, *, kout: int):
     For node A with ranked neighbors G[A]: detour(A, b) =
     #{a < b : G[A, b] ∈ G[G[A, a]]}. Edges are kept ordered by
     (detour count, original rank), truncated to ``kout``.
+
+    Membership is a SORTED two-hop adjacency + batched binary search
+    (O(kin² log kin) per node instead of the O(kin³) equality scan —
+    TPUs have no hash sets, but vmapped searchsorted vectorizes cleanly).
     """
     kin = graph.shape[1]
     rows = graph[chunk_ids]  # [c, kin]
@@ -220,15 +224,17 @@ def _detour_rerank_chunk(graph, chunk_ids, *, kout: int):
     # pollute detour counts, so gather clipped and mask the contribution.
     rows_valid = rows >= 0  # [c, kin]
     two_hop = graph[jnp.maximum(rows, 0)]  # [c, kin, kin]
+    th_sorted = jnp.sort(two_hop, axis=-1)
 
-    def body(a, counts):
-        # hit[c, b] = G[A, b] ∈ two_hop[A, a, :]
-        hit = jnp.any(two_hop[:, a, :, None] == rows[:, None, :], axis=1)
-        hit = hit & rows_valid[:, a][:, None]  # invalid rank-a edge: no 2-hop
-        rank_mask = jnp.arange(kin) > a  # only edges ranked after a
-        return counts + (hit & rank_mask[None, :]).astype(jnp.int32)
+    def member(th_a, targets):  # th_a [kin] sorted, targets [kin]
+        pos = jnp.clip(jnp.searchsorted(th_a, targets), 0, kin - 1)
+        return th_a[pos] == targets
 
-    counts = lax.fori_loop(0, kin, body, jnp.zeros(rows.shape, jnp.int32))
+    # hit[c, a, b] = G[A, b] ∈ G[G[A, a]]
+    hit = jax.vmap(jax.vmap(member, in_axes=(0, None)))(th_sorted, rows)
+    hit = hit & rows_valid[:, :, None]  # invalid rank-a edge: no 2-hop
+    a_lt_b = jnp.arange(kin)[:, None] < jnp.arange(kin)[None, :]
+    counts = jnp.sum(hit & a_lt_b[None, :, :], axis=1).astype(jnp.int32)
     # invalid (padded) edges sort last; order by (detour, rank) via one
     # composite-integer argsort
     counts = jnp.where(rows < 0, kin + 1, counts)
