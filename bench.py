@@ -133,12 +133,58 @@ def _load_data():
     return dataset, queries, "synthetic clustered"
 
 
+def _emit(payload):
+    print(json.dumps(payload), flush=True)
+
+
+def _watchdog(results, done, hard_s, t_all):
+    """If the run stalls (wedged device tunnel, tenancy crawl), emit the
+    best result recorded so far as the one JSON line and hard-exit —
+    a degraded row beats a driver timeout with no output at all."""
+    import threading
+
+    if not done.wait(hard_s):
+        ok = {
+            a: max((r for r in rows if r["recall"] >= MIN_RECALL), key=lambda r: r["qps"])
+            for a, rows in results.items()
+            if any(r["recall"] >= MIN_RECALL for r in rows)
+        }
+        best_algo, best = (
+            max(ok.items(), key=lambda kv: kv[1]["qps"]) if ok else ("none", {"qps": 0.0, "recall": 0.0, "config": "none"})
+        )
+        _emit(
+            {
+                "metric": "ann_best_qps_at_recall95_sift1m_synth_b1024_k10",
+                "value": best["qps"],
+                "unit": "qps",
+                "vs_baseline": round(best["qps"] / NOMINAL_BASELINE_QPS, 4),
+                "extra": {
+                    "best_algo": best_algo,
+                    "best_config": best.get("config"),
+                    "best_recall": best.get("recall"),
+                    "all_results": dict(results),
+                    "error": f"watchdog: bench exceeded {hard_s}s (device stall or tenancy crawl); partial results",
+                    "total_bench_seconds": round(time.perf_counter() - t_all, 1),
+                },
+            }
+        )
+        os._exit(3)
+
+
 def main():
+    import threading
+
     from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
     from raft_tpu.neighbors.refine import refine
     from raft_tpu.ops.distance import DistanceType
 
     t_all = time.perf_counter()
+    _results_for_watchdog = {}
+    _done = threading.Event()
+    hard_s = float(os.environ.get("RAFT_TPU_BENCH_HARD_TIMEOUT_S", 3300))
+    threading.Thread(
+        target=_watchdog, args=(_results_for_watchdog, _done, hard_s, t_all), daemon=True
+    ).start()
     hw = _hw_context()
     print(f"# hw: copy {hw['hbm_copy_gbps']} GB/s, bf16 {hw['bf16_matmul_tflops']} TFLOP/s", flush=True)
     dataset, queries, source = _load_data()
@@ -158,7 +204,7 @@ def main():
     def recall(i):
         return float(neighborhood_recall(np.asarray(i)[:, :K], gt))
 
-    results = {}  # algo -> list of (config, qps, recall)
+    results = _results_for_watchdog  # algo -> list of (config, qps, recall)
 
     def record(algo, config, dt, idx):
         results.setdefault(algo, []).append(
@@ -310,6 +356,7 @@ def main():
     except Exception as e:  # noqa: BLE001
         artifacts["error"] = f"{type(e).__name__}: {e}"[:200]
 
+    _done.set()
     print(
         json.dumps(
             {
