@@ -24,6 +24,17 @@ tables, so this is a nominal constant kept fixed across rounds).
 throughput at bench time: this TPU is time-shared behind a tunnel and
 wall-times swing ~2x with tenancy, so the headline only means something
 next to the hardware's throughput at that moment.
+``extra.efficiency`` separates kernel quality from tenancy:
+achieved-TFLOP/s / measured peak (MFU) for the exact path, and
+streamed-GB/s / measured copy bandwidth for the fused scans.
+
+Wedge-safety (the round-4 failure mode): the device tunnel can wedge so
+hard that backend init hangs forever. ``main()`` therefore probes the
+backend in a SUBPROCESS with a bounded timeout and retries/backoff; if
+the device never comes up it still emits one parsed JSON line from a
+CPU-smoke subprocess (clearly labeled via ``extra.error``) instead of a
+traceback or silence. The reference bench survives CUDA-free hosts the
+same way (``cpp/bench/ann/src/common/cuda_stub.hpp``).
 
 Artifacts: gbench-style JSON + CSV (data_export) + recall/QPS Pareto PNG
 (plot) under ``bench_artifacts/`` — the raft-ann-bench output surface.
@@ -35,6 +46,8 @@ results and scalars cross the host link (which on tethered dev TPUs is
 import dataclasses
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -56,6 +69,8 @@ CLUSTER_STD = 1.0  # same scale as the center spread: overlapping clusters
 #   artificially impossible and every IVF probe artificially perfect.
 NOMINAL_BASELINE_QPS = 600_000.0
 MIN_RECALL = 0.95
+METRIC = "ann_best_qps_at_recall95_sift1m_synth_b1024_k10"
+_CHILD_ENV = "_RAFT_TPU_BENCH_CHILD"
 
 
 def _timed(fn, nrep=2, inner=4):
@@ -140,38 +155,150 @@ def _emit(payload):
 def _watchdog(results, done, hard_s, t_all):
     """If the run stalls (wedged device tunnel, tenancy crawl), emit the
     best result recorded so far as the one JSON line and hard-exit —
-    a degraded row beats a driver timeout with no output at all."""
-    import threading
+    a degraded row beats a driver timeout with no output at all.
 
+    The whole body is exception-proof: the main thread mutates ``results``
+    concurrently, so snapshot first, and even a snapshot/compute failure
+    must still emit a minimal JSON line before exiting (an exception here
+    would silently kill the thread and reproduce the no-output hang this
+    watchdog exists to prevent)."""
     if not done.wait(hard_s):
-        ok = {
-            a: max((r for r in rows if r["recall"] >= MIN_RECALL), key=lambda r: r["qps"])
-            for a, rows in results.items()
-            if any(r["recall"] >= MIN_RECALL for r in rows)
-        }
-        best_algo, best = (
-            max(ok.items(), key=lambda kv: kv[1]["qps"]) if ok else ("none", {"qps": 0.0, "recall": 0.0, "config": "none"})
-        )
-        _emit(
-            {
-                "metric": "ann_best_qps_at_recall95_sift1m_synth_b1024_k10",
-                "value": best["qps"],
-                "unit": "qps",
-                "vs_baseline": round(best["qps"] / NOMINAL_BASELINE_QPS, 4),
-                "extra": {
-                    "best_algo": best_algo,
-                    "best_config": best.get("config"),
-                    "best_recall": best.get("recall"),
-                    "all_results": dict(results),
-                    "error": f"watchdog: bench exceeded {hard_s}s (device stall or tenancy crawl); partial results",
-                    "total_bench_seconds": round(time.perf_counter() - t_all, 1),
-                },
+        try:
+            snap = {a: list(rows) for a, rows in list(results.items())}
+            ok = {
+                a: max((r for r in rows if r["recall"] >= MIN_RECALL), key=lambda r: r["qps"])
+                for a, rows in snap.items()
+                if any(r["recall"] >= MIN_RECALL for r in rows)
             }
-        )
+            best_algo, best = (
+                max(ok.items(), key=lambda kv: kv[1]["qps"]) if ok else ("none", {"qps": 0.0, "recall": 0.0, "config": "none"})
+            )
+            _emit(
+                {
+                    "metric": METRIC,
+                    "value": best["qps"],
+                    "unit": "qps",
+                    "vs_baseline": round(best["qps"] / NOMINAL_BASELINE_QPS, 4),
+                    "extra": {
+                        "best_algo": best_algo,
+                        "best_config": best.get("config"),
+                        "best_recall": best.get("recall"),
+                        "all_results": snap,
+                        "error": f"watchdog: bench exceeded {hard_s}s (device stall or tenancy crawl); partial results",
+                        "total_bench_seconds": round(time.perf_counter() - t_all, 1),
+                    },
+                }
+            )
+        except Exception as e:  # noqa: BLE001 — last line of defense
+            _emit(
+                {
+                    "metric": METRIC,
+                    "value": 0.0,
+                    "unit": "qps",
+                    "vs_baseline": 0.0,
+                    "extra": {"error": f"watchdog stall + emit failure: {type(e).__name__}: {e}"[:300]},
+                }
+            )
         os._exit(3)
 
 
+def _probe_backend(timeout_s):
+    """Initialize the default JAX backend in a SUBPROCESS with a bounded
+    timeout. Returns (ok, info). Never touches a backend in this process,
+    so a wedged tunnel cannot hang the bench before it can report."""
+    code = "import jax; d = jax.devices(); print(d[0].platform, len(d), flush=True)"
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=timeout_s
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init exceeded {timeout_s:.0f}s (wedged device tunnel?)"
+    if p.returncode != 0:
+        return False, f"backend init rc={p.returncode}: " + p.stderr.strip()[-300:]
+    return True, p.stdout.strip()
+
+
+def _run_cpu_smoke_subprocess():
+    """Run the bench body on CPU at smoke scale in a subprocess and return
+    its parsed JSON payload (or None)."""
+    env = dict(os.environ)
+    env[_CHILD_ENV] = "1"
+    env["RAFT_TPU_BENCH_SMOKE"] = "1"
+    env.setdefault("RAFT_TPU_BENCH_HARD_TIMEOUT_S", "1500")
+    env.setdefault("RAFT_TPU_BENCH_BUDGET_S", "1200")
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import bench; bench._bench_main()"
+    )
+    p = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1800,
+    )
+    sys.stderr.write(p.stderr[-2000:])
+    for line in reversed(p.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
 def main():
+    """Wedge-safe wrapper: probe the backend out-of-process (bounded,
+    retried), run the real bench if it comes up, otherwise emit a parsed
+    JSON line from a CPU smoke run. Every path prints valid JSON."""
+    if os.environ.get(_CHILD_ENV):
+        _bench_main()
+        return
+    probe_timeout = float(os.environ.get("RAFT_TPU_BENCH_PROBE_TIMEOUT_S", 120))
+    retries = int(os.environ.get("RAFT_TPU_BENCH_PROBE_RETRIES", 2))
+    ok, err = False, None
+    for attempt in range(retries + 1):
+        ok, info = _probe_backend(probe_timeout)
+        if ok:
+            print(f"# backend probe ok: {info}", flush=True)
+            break
+        err = info
+        print(f"# backend probe failed (attempt {attempt + 1}/{retries + 1}): {info}", flush=True)
+        if attempt < retries:
+            time.sleep(min(60.0, 15.0 * (attempt + 1)))
+    if ok:
+        try:
+            _bench_main()
+            return
+        except Exception as e:  # noqa: BLE001 — fall back to CPU smoke below
+            err = f"bench failed after successful probe: {type(e).__name__}: {e}"[:300]
+            print(f"# {err}", flush=True)
+    try:
+        doc = _run_cpu_smoke_subprocess()
+    except Exception as e:  # noqa: BLE001
+        doc, err = None, f"{err}; cpu smoke failed: {type(e).__name__}: {e}"[:400]
+    if doc is not None:
+        doc.setdefault("extra", {})["error"] = (
+            f"device backend unavailable at bench time ({err}); "
+            "values below are a CPU SMOKE run, not TPU numbers"
+        )
+        doc["vs_baseline"] = 0.0
+        _emit(doc)
+        return
+    _emit(
+        {
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": "qps",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"no backend and cpu smoke failed: {err}"},
+        }
+    )
+
+
+def _bench_main():
     import threading
 
     from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
@@ -189,6 +316,7 @@ def main():
     print(f"# hw: copy {hw['hbm_copy_gbps']} GB/s, bf16 {hw['bf16_matmul_tflops']} TFLOP/s", flush=True)
     dataset, queries, source = _load_data()
     nq = int(queries.shape[0])
+    n_rows, dim = int(dataset.shape[0]), int(dataset.shape[1])
     float(jnp.sum(dataset[0]))
 
     # ground truth + exact brute-force timing
@@ -206,11 +334,11 @@ def main():
 
     results = _results_for_watchdog  # algo -> list of (config, qps, recall)
 
-    def record(algo, config, dt, idx):
-        results.setdefault(algo, []).append(
-            {"config": config, "qps": round(nq / dt, 1), "recall": round(recall(idx), 4)}
-        )
-        print(f"# {algo:16s} {config:40s} {nq/dt:>12,.0f} qps  recall={results[algo][-1]['recall']:.4f}",
+    def record(algo, config, dt, idx, **extra_fields):
+        row = {"config": config, "qps": round(nq / dt, 1), "recall": round(recall(idx), 4)}
+        row.update(extra_fields)
+        results.setdefault(algo, []).append(row)
+        print(f"# {algo:16s} {config:40s} {nq/dt:>12,.0f} qps  recall={row['recall']:.4f}",
               flush=True)
 
     # Global wall-clock guard: each phase checks it so the bench ALWAYS
@@ -221,17 +349,22 @@ def main():
         return time.perf_counter() - t_all > budget_s * frac
 
     build_times = {"brute_force": 0.0}
-    record("brute_force_exact", "tile=262144", t_exact, ei)
+    # achieved TFLOP/s on the exact path (2*n*d flops per query-row pair):
+    # the MFU numerator — separates kernel quality from tenancy swings.
+    exact_tflops = 2.0 * n_rows * dim * nq / t_exact / 1e12
+    record("brute_force_exact", "tile=262144", t_exact, ei,
+           achieved_tflops=round(exact_tflops, 2))
 
     dt, (v, i) = _timed(lambda: brute_force.search(bf, queries, K, mode="approx"))
     record("brute_force", "approx rt=0.99", dt, i)
 
     # ---- IVF-Flat: fused Pallas scan, bf16 lists, bank merge -------------
+    n_lists_flat = 1024
     t0 = time.perf_counter()
     fidx = ivf_flat.build(
         dataset,
         ivf_flat.IvfFlatIndexParams(
-            n_lists=1024, kmeans_n_iters=10, kmeans_trainset_fraction=0.1,
+            n_lists=n_lists_flat, kmeans_n_iters=10, kmeans_trainset_fraction=0.1,
             list_cap_factor=1.1,
         ),
     )
@@ -250,7 +383,10 @@ def main():
         dt, (v, i) = _timed(
             lambda sp=sp: ivf_flat.search(bf16_idx, queries, K, sp, mode="fused")
         )
-        record("ivf_flat", f"fused bf16 npr={npr} pf={pf} G={g} {merge}", dt, i)
+        # streamed bytes estimate: npr mean-sized lists of bf16 rows per query
+        gbps = npr / n_lists_flat * n_rows * dim * 2 * nq / dt / 1e9
+        record("ivf_flat", f"fused bf16 npr={npr} pf={pf} G={g} {merge}", dt, i,
+               stream_gbps_est=round(gbps, 1))
 
     # ---- IVF-PQ: fused Pallas scan, additive nibble codebooks ------------
     pidx = None
@@ -308,6 +444,26 @@ def main():
                 nrep=2,
             )
             record("cagra", f"itopk={itopk} w={w} dedup={dd}", dt, i)
+        # small-batch latency rows (the reference's single-CTA / multi-CTA
+        # operating modes, search_plan.cuh:81-164): ms per batch, not QPS.
+        if not over_budget(0.9):
+            for bq in (1, 10):
+                qs = queries[:bq]
+                sp_lat = cagra.plan_search_params(
+                    bq, K, n_rows, cagra.CagraSearchParams(itopk_size=128, dedup="post")
+                )
+                dt, (v, i) = _timed(
+                    lambda qs=qs, sp_lat=sp_lat: cagra.search(cidx, qs, K, sp_lat),
+                    nrep=2,
+                )
+                row_rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt[:bq]))
+                results.setdefault("cagra_latency", []).append(
+                    {"config": f"batch={bq} itopk={sp_lat.itopk_size} w={sp_lat.search_width}",
+                     "qps": round(bq / dt, 1),
+                     "recall": round(row_rec, 4), "latency_ms": round(dt * 1e3, 2)}
+                )
+                print(f"# cagra_latency    batch={bq:<4d} {dt*1e3:8.2f} ms  recall={row_rec:.4f}",
+                      flush=True)
     except Exception as e:  # noqa: BLE001 — a single-algo failure must not kill the bench
         cagra_err = cagra_err or f"{type(e).__name__}: {e}"[:200]
         print(f"# cagra skipped: {cagra_err}", flush=True)
@@ -315,10 +471,28 @@ def main():
     # operating points: best QPS at recall >= MIN_RECALL per algorithm
     ops = {}
     for algo, rows in results.items():
+        if algo == "cagra_latency":
+            continue
         ok = [r for r in rows if r["recall"] >= MIN_RECALL]
         ops[algo] = max(ok, key=lambda r: r["qps"]) if ok else None
     reached = {a: r for a, r in ops.items() if r is not None}
     best_algo, best = max(reached.items(), key=lambda kv: kv[1]["qps"])
+
+    # efficiency: kernel quality separated from tenancy (VERDICT r4 #9)
+    efficiency = {
+        "exact_achieved_tflops": round(exact_tflops, 2),
+        "mfu_vs_measured_peak": (
+            round(exact_tflops / hw["bf16_matmul_tflops"], 3)
+            if hw["bf16_matmul_tflops"] > 0 else None
+        ),
+    }
+    flat_best = ops.get("ivf_flat")
+    if flat_best and "stream_gbps_est" in flat_best:
+        efficiency["fused_stream_gbps_est"] = flat_best["stream_gbps_est"]
+        efficiency["fused_frac_of_measured_copy_bw"] = (
+            round(flat_best["stream_gbps_est"] / hw["hbm_copy_gbps"], 3)
+            if hw["hbm_copy_gbps"] > 0 else None
+        )
 
     # ---- artifacts: gbench JSON + CSV + Pareto plot (L8 parity) ----------
     artifacts = {}
@@ -334,8 +508,8 @@ def main():
                     "n_queries": nq,
                     "Recall": r["recall"],
                     "items_per_second": r["qps"],
-                    "Latency": round(nq / r["qps"], 6),
-                    "end_to_end": round(nq / r["qps"], 6),
+                    "Latency": round(nq / r["qps"], 6) if r["qps"] else 0.0,
+                    "end_to_end": round(nq / r["qps"], 6) if r["qps"] else 0.0,
                     "build_time": build_times.get(algo.replace("_exact", ""), 0.0),
                     "build_params": {},
                     "search_params": {"config": r["config"]},
@@ -360,7 +534,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "ann_best_qps_at_recall95_sift1m_synth_b1024_k10",
+                "metric": METRIC,
                 "value": best["qps"],
                 "unit": "qps",
                 "vs_baseline": round(best["qps"] / NOMINAL_BASELINE_QPS, 4),
@@ -375,10 +549,11 @@ def main():
                     "build_seconds": build_times,
                     "cagra_error": cagra_err,
                     "hw_context": hw,
+                    "efficiency": efficiency,
                     "data_source": source,
                     "artifacts": artifacts,
-                    "n": int(dataset.shape[0]),
-                    "dim": int(dataset.shape[1]),
+                    "n": n_rows,
+                    "dim": dim,
                     "n_queries": nq,
                     "k": K,
                     "device": str(jax.devices()[0]),

@@ -121,9 +121,10 @@ def _search_ivf_pq(index, queries, k: int, p: Dict[str, Any], batch: int):
 
     from raft_tpu.neighbors import ivf_pq, refine as refine_mod
 
-    lut = {"float": jnp.float32, "half": jnp.bfloat16, "bf16": jnp.bfloat16, "fp8": jnp.bfloat16}[
-        p.get("smemLutDtype", "float")
-    ]
+    # only an EXPLICIT smemLutDtype is a precision demand; absent = auto
+    # (None), which lets mode="auto" keep the fused bf16-LUT fast path
+    lut_map = {"float": jnp.float32, "half": jnp.bfloat16, "bf16": jnp.bfloat16, "fp8": jnp.bfloat16}
+    lut = lut_map[p["smemLutDtype"]] if "smemLutDtype" in p else None
     rr = p.get("refine_ratio", 1)
     kk = k * rr
     d, i = ivf_pq.search(

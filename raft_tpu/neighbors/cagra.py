@@ -591,9 +591,14 @@ def _cagra_search_impl(
         return out_v, out_i, out_f
 
     buf_v, buf_i, buf_f = lax.fori_loop(0, iters, body, (buf_v, buf_i, buf_f))
-    if dedup == "none":
+    if dedup in ("none", "post"):
         # one final sort-dedup so duplicate ids cannot occupy several of
-        # the returned top-k slots
+        # the returned top-k slots. Needed for "post" too: the shared-seed
+        # init scores via a [nq,s] dot while loop expansions use score()'s
+        # einsum, and the two contractions can round differently — an
+        # init-seeded node re-proposed during expansion then isn't
+        # value-adjacent to its buffered copy, so the per-iteration
+        # adjacent-id kill misses it
         buf_v, buf_i, buf_f = running_merge_unique(
             buf_v, buf_i,
             jnp.full((nq, 1), worst, jnp.float32), jnp.full((nq, 1), -1, jnp.int32),
@@ -618,6 +623,44 @@ def strided_seed_ids(size: int, sample: int) -> jnp.ndarray:
     # jax_enable_x64 is off, and i * size overflows int32 at ~2k seeds on
     # a 1M-row index
     return jnp.asarray((np.arange(s, dtype=np.int64) * size) // s, jnp.int32)
+
+
+def plan_search_params(
+    nq: int, k: int, size: int, base: Optional["CagraSearchParams"] = None
+) -> "CagraSearchParams":
+    """Pick the search schedule from the query-batch shape — the
+    ``search_plan.cuh:81-164`` plan-selection analog. The reference
+    chooses among three kernel schedules (single-CTA for big batches,
+    multi-CTA / multi-kernel to keep one GPU busy on few queries); on TPU
+    a single fused batched schedule serves every shape, so the plan
+    instead moves the latency/throughput trade through
+    ``(search_width, init_sample)``:
+
+    * **tiny batches** (the multi-CTA / multi-kernel regime): wall-clock
+      is ``iters`` sequential gather+score steps and the chip is idle —
+      widen the beam (width 8), which cuts the auto iteration count
+      ``~itopk/width`` by ~8x at the cost of per-step work the idle chip
+      absorbs, and seed from a larger strided sample (one cheap matmul)
+      so fewer hops are needed.
+    * **large batches** (single-CTA regime): the batch axis already
+      fills the chip; keep the narrow default beam.
+
+    Explicit non-default ``base`` values are respected — the plan only
+    raises knobs the caller left at their defaults."""
+    base = base or CagraSearchParams()
+    width = base.search_width
+    init = base.init_sample
+    width_is_default = width == CagraSearchParams.search_width
+    if nq <= 32:
+        if width_is_default:
+            width = 8
+        if init == CagraSearchParams.init_sample:
+            init = min(size, 4 * CagraSearchParams.init_sample)
+    elif nq <= 256 and width_is_default:
+        width = 2
+    return dataclasses.replace(
+        base, itopk_size=max(base.itopk_size, k), search_width=width, init_sample=init
+    )
 
 
 def derive_search_config(params: "CagraSearchParams", k: int, size: int):

@@ -555,8 +555,18 @@ def _batched_search(run, queries, query_batch: int):
 # centers array: computing the PCA-bisection rank is a host-side walk we
 # don't want per search call, and caching ON the index object (as an
 # attribute) is a mutation of user-owned state that doesn't survive
-# serialization or pytree transforms. Weak refs let index arrays die.
+# serialization or pytree transforms. Weak refs let index arrays die;
+# arrays that refuse weakrefs would otherwise pin themselves forever, so
+# the cache is also capped (FIFO evict) — a long-running server loading
+# many legacy indexes must not grow without bound.
 _RANK_CACHE: dict = {}
+_RANK_CACHE_MAX = 64
+
+
+def _rank_cache_put(key, ref, value):
+    _RANK_CACHE[key] = (ref, value)
+    while len(_RANK_CACHE) > _RANK_CACHE_MAX:
+        _RANK_CACHE.pop(next(iter(_RANK_CACHE)))
 
 
 def _legacy_rank_cache(centers) -> jax.Array:
@@ -571,9 +581,9 @@ def _legacy_rank_cache(centers) -> jax.Array:
     rank = jnp.asarray(spatial_center_rank(np.asarray(centers)))
     try:
         ref = weakref.ref(centers, lambda _: _RANK_CACHE.pop(key, None))
-    except TypeError:  # some array types refuse weakrefs; cache without eviction
+    except TypeError:  # some array types refuse weakrefs; FIFO cap evicts
         ref = lambda: centers  # noqa: E731
-    _RANK_CACHE[key] = (ref, rank)
+    _rank_cache_put(key, ref, rank)
     return rank
 
 
@@ -590,7 +600,7 @@ def _rank_is_identity(rank) -> bool:
         ref = weakref.ref(rank, lambda _: _RANK_CACHE.pop(("ident", key), None))
     except TypeError:
         ref = lambda: rank  # noqa: E731
-    _RANK_CACHE[("ident", key)] = (ref, ident)
+    _rank_cache_put(("ident", key), ref, ident)
     return ident
 
 

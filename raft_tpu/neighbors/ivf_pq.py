@@ -30,16 +30,21 @@ TPU-first redesign:
   of only the probed code blocks + an in-kernel multi-hot-matmul LUT
   apply — the work-proportional fast path mirroring the reference's
   ``compute_similarity`` kernel. See :mod:`raft_tpu.ops.pallas.pq_scan`.
-  It needs ``ksub <= 64`` (pq_bits <= 6), OR ``pq_kind="nibble"``:
-  **additive nibble codebooks** — each subspace quantized by the SUM of
-  two 16-entry codebooks (A[hi] + B[lo], one byte per code) — 256
-  effective centers at 32-column LUT cost, the TPU-native answer to the
-  reference's fp8-LUT trick (2-level residual quantization instead of
-  low-precision table entries).
-* ``pq_bits=4`` codes are **bit-packed** two per byte
-  (``ivf_pq_types.hpp:129-164`` / ``detail/ivf_pq_codepacking.cuh``
-  analog — pairwise packing, not 16-byte interleave: TPU DMA wants plain
-  contiguous bytes), halving code storage and scan DMA.
+  Every ``per_subspace`` width is eligible: ``ksub <= 64`` decodes in a
+  single multi-hot pass; ``ksub = 128/256`` (including the DEFAULT
+  ``pq_bits=8`` kmeans config) via **column-chunked decode** (round 5) —
+  the work-proportional answer to the LUT-cost problem the reference
+  solves with fp8 LUTs. ``pq_kind="nibble"`` remains the cheap 8-bit
+  point: **additive nibble codebooks** — each subspace quantized by the
+  SUM of two 16-entry codebooks (A[hi] + B[lo], one byte per code) — 256
+  effective centers at 32-column LUT cost (2-level residual quantization
+  instead of low-precision table entries).
+* ``pq_bits < 8`` codes are **bit-packed** whenever the row bitstream is
+  byte-aligned: two per byte for 4-bit, spanning little-endian layouts
+  for 5/6/7 (``ivf_pq_types.hpp:129-164`` /
+  ``detail/ivf_pq_codepacking.cuh`` analog — plain contiguous bytes, not
+  16-byte interleave: TPU DMA wants flat rows), cutting code storage and
+  scan DMA to ``pq_bits/8`` of a byte per code.
 
 Supported metrics: L2Expanded, L2SqrtExpanded, InnerProduct.
 """
@@ -124,12 +129,21 @@ class IvfPqSearchParams:
     they mirror :class:`raft_tpu.neighbors.ivf_flat.IvfFlatSearchParams`."""
 
     n_probes: int = 20
-    lut_dtype: jnp.dtype = jnp.float32  # bf16 = reduced-precision LUT mode
+    # LUT precision (the reference's ``lut_dtype``, ivf_pq_types.hpp:120).
+    # None = auto: float32 on the scan/probe paths, bf16 on the fused
+    # Pallas path (whose LUT matmul is MXU-bf16 by construction).
+    # Explicitly requesting float32 makes ``mode="auto"`` route to the
+    # scan path, which honors it; ``mode="fused"`` always computes the
+    # LUT in bf16 regardless.
+    lut_dtype: Optional[jnp.dtype] = None
     fused_qt: int = 128
     fused_probe_factor: int = 32
     fused_group: int = 8
     fused_merge: str = "bank8"
     fused_extract_every: int = 0
+    # max multi-hot columns materialized per decode chunk (VMEM bound for
+    # wide codebooks: K = pq_dim * ksub columns total); 0 = single pass
+    fused_decode_cols: int = 2048
 
 
 @jax.tree_util.register_pytree_node_class
@@ -206,7 +220,7 @@ class IvfPqIndex:
 
     @property
     def pq_dim(self) -> int:
-        return self.codes.shape[2] * 2 if self.packed else self.codes.shape[2]
+        return self.codes.shape[2] * 8 // self.pq_bits if self.packed else self.codes.shape[2]
 
     @property
     def pq_len(self) -> int:
@@ -222,7 +236,9 @@ class IvfPqIndex:
 
     def codes_unpacked(self) -> jax.Array:
         """[n_lists, max_list, pq_dim] u8 view for the XLA decode paths."""
-        return unpack_codes(self.codes) if self.packed else self.codes
+        if not self.packed:
+            return self.codes
+        return unpack_codes_bits(self.codes, self.pq_bits, self.pq_dim)
 
 
 # ---------------------------------------------------------------------------
@@ -310,6 +326,37 @@ def unpack_codes(packed) -> jax.Array:
     lo = packed & jnp.uint8(15)
     hi = packed >> 4
     return jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], -1)
+
+
+def pack_codes_bits(codes, bits: int) -> jax.Array:
+    """Bit-pack ``bits``-wide codes as a little-endian bitstream per row:
+    code j occupies global bits ``[j*bits, (j+1)*bits)``, bit t of byte s
+    is global bit ``s*8 + t``. Requires ``pq_dim * bits % 8 == 0`` (the
+    row bitstream is byte-aligned, so codes never span rows). For
+    ``bits=4`` this is exactly :func:`pack_codes`'s pairwise layout.
+    Spanning-width analog of the reference's per-width chunk packing
+    (``ivf_pq_types.hpp:129-164``, ``detail/ivf_pq_codepacking.cuh``)."""
+    if bits == 4:
+        return pack_codes(codes)
+    pq_dim = codes.shape[-1]
+    expects(pq_dim * bits % 8 == 0, "pq_dim*bits must be byte-aligned to pack")
+    bpr = pq_dim * bits // 8
+    c = codes.astype(jnp.uint32)
+    bit = (c[..., None] >> jnp.arange(bits, dtype=jnp.uint32)) & 1
+    by = bit.reshape(*codes.shape[:-1], bpr, 8)
+    w = jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32)
+    return jnp.sum(by * w, axis=-1).astype(jnp.uint8)
+
+
+def unpack_codes_bits(packed, bits: int, pq_dim: int) -> jax.Array:
+    """Inverse of :func:`pack_codes_bits`."""
+    if bits == 4:
+        return unpack_codes(packed)
+    p = packed.astype(jnp.uint32)
+    bit = (p[..., None] >> jnp.arange(8, dtype=jnp.uint32)) & 1
+    co = bit.reshape(*packed.shape[:-1], pq_dim, bits)
+    w = jnp.uint32(1) << jnp.arange(bits, dtype=jnp.uint32)
+    return jnp.sum(co * w, axis=-1).astype(jnp.uint8)
 
 
 def nibble_books(pq_centers) -> jax.Array:
@@ -597,9 +644,13 @@ def build(
         codes_dev, jnp.arange(n, dtype=jnp.int32), slot, n_lists=n_lists, max_list=max_list
     )
     rot_sqnorms = _sqnorms_for(codes, centers_rot, pq_centers, per_cluster)
-    packed = params.pq_bits == 4 and pq_dim % 2 == 0
+    # bit-pack sub-byte widths whenever the row bitstream is byte-aligned
+    # (4: two per byte; 3/5/6/7: spanning little-endian — all decoded by
+    # the fused kernel's generic b-mode). Reference:
+    # ivf_pq_types.hpp:129-164.
+    packed = not nibble and params.pq_bits < 8 and (pq_dim * params.pq_bits) % 8 == 0
     if packed:
-        codes = pack_codes(codes)
+        codes = pack_codes_bits(codes, params.pq_bits)
 
     return IvfPqIndex(
         centers=centers,
@@ -675,7 +726,7 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     sqn = _sqnorms_for(codes, index.centers_rot, index.pq_centers, per_cluster)
     return dataclasses.replace(
         index,
-        codes=pack_codes(codes) if index.packed else codes,
+        codes=pack_codes_bits(codes, index.pq_bits) if index.packed else codes,
         list_indices=list_indices,
         list_sizes=list_sizes,
         rot_sqnorms=sqn,
@@ -1005,7 +1056,8 @@ def scan_bf16(lut_dtype) -> bool:
     """Reduced-precision decode/score is a TPU-only mode (the CPU dot
     thunk has no bf16 support)."""
     return (
-        jnp.dtype(lut_dtype) == jnp.dtype(jnp.bfloat16)
+        lut_dtype is not None
+        and jnp.dtype(lut_dtype) == jnp.dtype(jnp.bfloat16)
         and jax.default_backend() == "tpu"
     )
 
@@ -1029,8 +1081,9 @@ def search(
 
     ``mode``: ``"fused"`` = the Pallas fused probed-list scan (DMAs only
     the probed CODE blocks — the work-proportional TPU fast path, see
-    :mod:`raft_tpu.ops.pallas.pq_scan`; needs ksub <= 64 or additive
-    nibble codebooks, per_subspace, and a supported metric); ``"scan"`` =
+    :mod:`raft_tpu.ops.pallas.pq_scan`; needs per_subspace codebooks and
+    a supported metric; any ksub <= 256 including the default 8-bit
+    config, wide books via column-chunked decode); ``"scan"`` =
     dense decode-and-score over list chunks (see
     :func:`_ivf_pq_scan_impl` — same probed candidate set, selected with
     the fused APPROXIMATE top-k so results can differ slightly from the
@@ -1050,13 +1103,23 @@ def search(
     nq = queries.shape[0]
     filter_bits = prefilter.bits if prefilter is not None else None
 
+    # every per_subspace width is fused-eligible: ksub <= 64 decodes in one
+    # multi-hot pass, 128/256 (the reference's DEFAULT pq_bits=8 config)
+    # via column-chunked decode — the work-proportional answer to the LUT
+    # cost the reference solves with fp8 LUTs (detail/ivf_pq_fp_8bit.cuh)
     fused_ok = (
         index.codebook_kind == PER_SUBSPACE
-        and (index.additive or index.packed or index.ksub <= 64)
+        and (index.additive or index.ksub <= 256)
         and index.metric in _SUPPORTED
     )
+    # the fused kernel's LUT is bf16 by construction; an explicit float32
+    # request is a precision demand auto must honor via the scan path
+    wants_f32_lut = (
+        params.lut_dtype is not None
+        and jnp.dtype(params.lut_dtype) == jnp.dtype(jnp.float32)
+    )
     if mode == "auto":
-        if nq >= 128 and jax.default_backend() == "tpu" and fused_ok:
+        if nq >= 128 and jax.default_backend() == "tpu" and fused_ok and not wants_f32_lut:
             mode = "fused"
         else:
             mode = "scan" if nq >= 128 else "probe"
@@ -1067,14 +1130,18 @@ def search(
     if mode == "fused":
         from raft_tpu.ops.pallas.pq_scan import ivf_pq_fused_search
 
-        expects(fused_ok, "fused mode needs per_subspace + (ksub<=64 | nibble | packed)")
+        expects(fused_ok, "fused mode needs per_subspace + (ksub<=256 | nibble)")
         if index.additive:
             books, code_mode, ksub = nibble_books(index.pq_centers), "nib8", 16
-        elif index.packed:
+        elif index.packed and index.pq_bits == 4:
             # packed codes: byte b = (code 2b, code 2b+1); W's natural
             # [nq, pq_dim, 16] flattening is exactly the kernel's per-byte
             # [lo-hot | hi-hot] column order, so books pass through as-is
             books, code_mode, ksub = index.pq_centers, "p4", 16
+        elif index.packed:
+            # 3/5/6/7-bit spanning bitstream: kernel peels each code from
+            # its (low, high) byte pair; W keeps the natural j-major order
+            books, code_mode, ksub = index.pq_centers, f"b{index.pq_bits}", index.ksub
         else:
             books, code_mode, ksub = index.pq_centers, "u8", index.ksub
         rank = index.center_rank
@@ -1113,6 +1180,7 @@ def search(
                 code_mode=code_mode,
                 ksub=ksub,
                 extract_every=params.fused_extract_every,
+                decode_cols=params.fused_decode_cols,
                 interpret=jax.default_backend() != "tpu",
             )
 
@@ -1185,7 +1253,7 @@ def search(
             metric=index.metric,
             per_cluster=index.codebook_kind == PER_CLUSTER,
             has_filter=filter_bits is not None,
-            lut_dtype=jnp.dtype(params.lut_dtype).name,
+            lut_dtype=jnp.dtype(params.lut_dtype or jnp.float32).name,
         )
         if bpad:
             v, i = v[:-bpad], i[:-bpad]

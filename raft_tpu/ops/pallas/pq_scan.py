@@ -72,27 +72,73 @@ def supported_metric(metric: DistanceType) -> bool:
     return metric in _SUPPORTED
 
 
-def _multi_hot(cod, *, code_mode: str, ksub: int, m: int, bpr: int):
-    """Expand a [m, bpr] uint8 code block to the multi-hot ``S [m, K]``
-    bf16 the decode matmul consumes. K-column order must match the W
+def _code_groups(code_mode: str, ksub: int, bpr: int) -> Tuple[int, int]:
+    """(n_groups, gw): the multi-hot column space is ``n_groups`` groups
+    of ``gw`` columns — one group per stored byte for u8/nib8/p4, one per
+    CODE for the spanning b3/b5/b6/b7 layouts."""
+    if code_mode in ("b3", "b5", "b6", "b7"):
+        b = int(code_mode[1:])
+        return bpr * 8 // b, ksub
+    return bpr, (ksub if code_mode == "u8" else 32)
+
+
+def _multi_hot(cod, *, code_mode: str, ksub: int, m: int, bpr: int,
+               g0: int = 0, ng: int = 0):
+    """Expand a [m, bpr] uint8 code block to the multi-hot ``S [m, Kc]``
+    bf16 the decode matmul consumes — the column chunk covering groups
+    ``[g0, g0 + ng)`` of the full K-column space (``ng=0`` = all groups;
+    chunking keeps S inside VMEM for 256-entry codebooks, where the full
+    K = pq_dim * 256 would be tens of MB). Column order must match the W
     layout built in :func:`pq_lut`.
 
     Built entirely in 2D (Mosaic rejects collapsing a 3D one-hot's minor
     dims): a tiny "spread" matmul broadcasts byte j across its K-column
     group (code values <= 255 are exact in bf16/f32), nibbles are peeled
-    arithmetically, and one lane-iota compare yields the one-hots."""
-    gw = ksub if code_mode == "u8" else 32  # K columns per stored byte
-    K = bpr * gw
+    arithmetically, and one lane-iota compare yields the one-hots.
+
+    ``"b3"``/``"b5"``/``"b6"``/``"b7"`` (spanning little-endian bitstreams) use
+    TWO spread matmuls — code j's low byte ``(j*b)//8`` and high byte one
+    past it — then peel the value with power-of-two floor arithmetic
+    (shifts <= 7 of bytes <= 255: every intermediate is an exact f32
+    integer)."""
+    n_groups, gw = _code_groups(code_mode, ksub, bpr)
+    if not ng:
+        ng = n_groups
+    Kc = ng * gw
     # u8 -> f32 via i32 (Mosaic has no direct u8 -> float cast)
     codf = cod.astype(jnp.int32).astype(jnp.float32)  # [m, bpr]
-    ej = lax.broadcasted_iota(jnp.int32, (bpr, K), 0)
-    ec = lax.broadcasted_iota(jnp.int32, (bpr, K), 1)
-    spread = (ec // gw == ej).astype(jnp.float32)  # [bpr, K] block-constant
+    ej = lax.broadcasted_iota(jnp.int32, (bpr, Kc), 0)
+    ec = lax.broadcasted_iota(jnp.int32, (bpr, Kc), 1)
+    lane = lax.broadcasted_iota(jnp.int32, (m, Kc), 1)
+    if code_mode in ("b3", "b5", "b6", "b7"):
+        b = int(code_mode[1:])
+        jb = (g0 + ec // ksub) * b  # code j's first global bit, per column
+        s_lo = (ej == jb // 8).astype(jnp.float32)
+        s_hi = (ej == jb // 8 + 1).astype(jnp.float32)  # all-zero col when
+        #   the code ends inside its low byte OR at the row's last byte
+        bl = lax.dot_general(
+            codf, s_lo, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [m, Kc]
+        bh = lax.dot_general(
+            codf, s_hi, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        off = ((g0 + lane // ksub) * b) % 8
+        lo_bits = jnp.minimum(8 - off, b)
+        p_off = jnp.exp2(-off.astype(jnp.float32))
+        p_lob = jnp.exp2(lo_bits.astype(jnp.float32))
+        p_hib = jnp.exp2((b - lo_bits).astype(jnp.float32))
+        t = jnp.floor(bl * p_off)  # low byte >> off
+        v_lo = t - jnp.floor(t / p_lob) * p_lob  # ... & (2^lo_bits - 1)
+        v_hi = (bh - jnp.floor(bh / p_hib) * p_hib) * p_lob
+        sub = (lane % ksub).astype(jnp.float32)
+        return (v_lo + v_hi == sub).astype(jnp.bfloat16)
+    spread = (g0 + ec // gw == ej).astype(jnp.float32)  # [bpr, Kc] block-const
     byte_lane = lax.dot_general(
         codf, spread, dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # [m, K] — byte j's value on each of its gw lanes
-    lane = lax.broadcasted_iota(jnp.int32, (m, K), 1)
+    )  # [m, Kc] — byte g0+j's value on each of its gw lanes
     if code_mode == "u8":
         sub = (lane % gw).astype(jnp.float32)
         return (byte_lane == sub).astype(jnp.bfloat16)
@@ -108,8 +154,13 @@ def _multi_hot(cod, *, code_mode: str, ksub: int, m: int, bpr: int):
 
 
 def _make_pq_kernel(*, k, metric, merge, qt, m, g_lists, n_steps, K,
-                    code_mode, ksub, bpr, extract_every):
+                    code_mode, ksub, bpr, extract_every, decode_cols):
     banks = _eff_banks(merge, m, 0)
+    n_groups, gw = _code_groups(code_mode, ksub, bpr)
+    # decode in column chunks so S stays VMEM-resident even for 256-entry
+    # codebooks (K = pq_dim * 256); a chunk covers whole groups
+    chunk_groups = n_groups if not decode_cols else max(1, decode_cols // gw)
+    chunk_groups = min(chunk_groups, n_groups)
 
     def kernel(pr_ref, pv_ref, w_ref, qrot_ref, crot_ref, cod_ref, ln_ref,
                outv_ref, outi_ref, accv, acci, bankv, banki):
@@ -140,13 +191,19 @@ def _make_pq_kernel(*, k, metric, merge, qt, m, g_lists, n_steps, K,
             # scalar column instead of a [qt, m] pass
             for g in range(g_lists):
                 cod = cod_ref[0, g * m : (g + 1) * m, :]  # [m, bpr] u8
-                s = _multi_hot(cod, code_mode=code_mode, ksub=ksub, m=m, bpr=bpr)
-                dot = lax.dot_general(
-                    w,
-                    s,
-                    dimension_numbers=(((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )  # [qt, m]
+                dot = jnp.zeros((qt, m), jnp.float32)
+                for g0 in range(0, n_groups, chunk_groups):
+                    ngc = min(chunk_groups, n_groups - g0)
+                    s = _multi_hot(
+                        cod, code_mode=code_mode, ksub=ksub, m=m, bpr=bpr,
+                        g0=g0, ng=ngc,
+                    )
+                    dot = dot + lax.dot_general(
+                        w[:, g0 * gw : (g0 + ngc) * gw],
+                        s,
+                        dimension_numbers=(((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )  # [qt, m]
                 ln = ln_ref[0, 0, g * m : (g + 1) * m]  # prepared epilogue
                 if metric == DistanceType.InnerProduct:
                     score = ln[None, :] - dot - qdc[:, g][:, None]
@@ -198,7 +255,8 @@ def pq_lut(q_rot, books) -> jax.Array:
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "k", "metric", "qt", "merge", "code_mode", "ksub", "extract_every", "interpret"
+        "k", "metric", "qt", "merge", "code_mode", "ksub", "extract_every",
+        "decode_cols", "interpret",
     ),
 )
 def fused_pq_topk(
@@ -217,6 +275,7 @@ def fused_pq_topk(
     code_mode: str = "u8",
     ksub: int = 16,
     extract_every: int = 0,
+    decode_cols: int = 2048,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run the fused probed-list PQ scan; returns ``(scores [nq_pad, k]
@@ -233,7 +292,7 @@ def fused_pq_topk(
     kernel = _make_pq_kernel(
         k=k, metric=metric, merge=merge, qt=qt, m=m, g_lists=g_lists,
         n_steps=n_steps, K=K, code_mode=code_mode, ksub=ksub, bpr=bpr,
-        extract_every=extract_every,
+        extract_every=extract_every, decode_cols=decode_cols,
     )
     banks = _eff_banks(merge, m, 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -272,7 +331,8 @@ def fused_pq_topk(
     jax.jit,
     static_argnames=(
         "k", "n_probes", "metric", "qt", "probe_factor", "group",
-        "has_filter", "merge", "code_mode", "ksub", "extract_every", "interpret",
+        "has_filter", "merge", "code_mode", "ksub", "extract_every",
+        "decode_cols", "interpret",
     ),
 )
 def ivf_pq_fused_search(
@@ -298,6 +358,7 @@ def ivf_pq_fused_search(
     code_mode: str = "u8",
     ksub: int = 16,
     extract_every: int = 0,
+    decode_cols: int = 2048,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """IVF-PQ search through the Pallas fused scan. Candidate-set
@@ -356,6 +417,7 @@ def ivf_pq_fused_search(
         code_mode=code_mode,
         ksub=ksub,
         extract_every=extract_every,
+        decode_cols=decode_cols,
         interpret=interpret,
     )
 

@@ -21,6 +21,7 @@ TPU-first, two regimes:
 """
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -32,8 +33,8 @@ from raft_tpu.ops.distance import DistanceType, pairwise_distance, resolve_metri
 from raft_tpu.ops.select_k import running_merge, select_k, worst_value
 from raft_tpu.sparse.types import CSR
 
-# metrics expressible as f(gram, row stats) — the native-CSR set
-_NATIVE = frozenset(
+# metrics expressible as f(gram, row stats) — the gram native-CSR set
+_NATIVE_GRAM = frozenset(
     {
         DistanceType.InnerProduct,
         DistanceType.CosineExpanded,
@@ -44,6 +45,24 @@ _NATIVE = frozenset(
         DistanceType.DiceExpanded,
     }
 )
+# metrics needing the UNION of nonzero columns (|a-b| family) — covered
+# by the same padded-row sort-merge, accumulating elementwise terms over
+# x-side matches plus unmatched y-side entries (the reference computes
+# these with its load-balanced CSR walkers, sparse/distance/detail/
+# lp_distance.cuh / l2_distance.cuh)
+_NATIVE_UNION = frozenset(
+    {
+        DistanceType.L1,
+        DistanceType.Linf,
+        DistanceType.Canberra,
+        DistanceType.LpUnexpanded,
+        DistanceType.L2Unexpanded,
+        DistanceType.L2SqrtUnexpanded,
+        DistanceType.HammingUnexpanded,
+        DistanceType.BrayCurtis,
+    }
+)
+_NATIVE = _NATIVE_GRAM | _NATIVE_UNION
 
 
 def _densify_rows(a: CSR, start: int, count: int, rows=None) -> jax.Array:
@@ -92,6 +111,81 @@ def _gram_block(xi, xv, yi, yv):
     return jnp.transpose(jax.vmap(one_y)(yi, yv))  # [mi, nj]
 
 
+@functools.partial(jax.jit, static_argnames=("kind", "use_max"))
+def _union_block(xi, xv, yi, yv, kind, use_max, p):
+    """Union-of-nonzeros accumulation over padded row blocks: ``[mi, nj]``
+    of ``reduce_c term(x[i,c], y[j,c])`` over every column where either
+    row is nonzero. Terms vanish at (0, 0), so the union decomposes as
+    (x entries, matched-or-zero y) + (unmatched y entries, zero x) — both
+    sides found with batched binary search; padding sentinels never match
+    and their (0, 0) terms are guarded to 0."""
+
+    def term(a, b):
+        ad = jnp.abs(a - b)
+        if kind == "l1" or kind == "linf":
+            return ad
+        if kind == "lp":
+            return ad**p
+        if kind == "canberra":
+            den = jnp.abs(a) + jnp.abs(b)
+            return jnp.where(den > 0.0, ad / jnp.where(den > 0.0, den, 1.0), 0.0)
+        return (a != b).astype(jnp.float32)  # hamming
+
+    def one_y(yrow_i, yrow_v):
+        r2 = yrow_i.shape[0]
+        pos = jnp.clip(jnp.searchsorted(yrow_i, xi), 0, r2 - 1)  # [mi, r1]
+        hit = yrow_i[pos] == xi
+        b = jnp.where(hit, yrow_v[pos], 0.0)
+        # y entries with no x match: one searchsorted per x row
+        pos2 = jax.vmap(lambda xrow: jnp.searchsorted(xrow, yrow_i))(xi)  # [mi, r2]
+        pos2 = jnp.clip(pos2, 0, xi.shape[1] - 1)
+        hit2 = jnp.take_along_axis(xi, pos2, axis=1) == yrow_i[None, :]
+        if kind == "bc":
+            # braycurtis needs sum|a-b| AND sum|a+b| — one merge, two
+            # channels (the match work dominates; don't do it twice)
+            num = jnp.sum(jnp.abs(xv - b), axis=1) + jnp.sum(
+                jnp.where(hit2, 0.0, jnp.abs(yrow_v)[None, :]), axis=1
+            )
+            den = jnp.sum(jnp.abs(xv + b), axis=1) + jnp.sum(
+                jnp.where(hit2, 0.0, jnp.abs(yrow_v)[None, :]), axis=1
+            )
+            return jnp.stack([num, den])  # [2, mi]
+        left = term(xv, b)  # [mi, r1]; padding x rows give term(0,0)=0
+        right = jnp.where(hit2, 0.0, term(0.0, yrow_v)[None, :])  # [mi, r2]
+        if use_max:
+            return jnp.maximum(jnp.max(left, axis=1), jnp.max(right, axis=1))
+        return jnp.sum(left, axis=1) + jnp.sum(right, axis=1)
+
+    out = jax.vmap(one_y)(yi, yv)  # [nj, mi] or [nj, 2, mi]
+    if kind == "bc":
+        return jnp.transpose(out, (2, 0, 1))  # [mi, nj, 2]
+    return jnp.transpose(out)  # [mi, nj]
+
+
+def _union_accumulate(
+    x: CSR, y: CSR, kind: str, use_max: bool = False, p: float = 2.0, pair_block: int = 512
+) -> jax.Array:
+    """Blocked [m, n] union accumulation (see :func:`_union_block`)."""
+    expects(x.shape[1] == y.shape[1], "feature dim mismatch")
+    xi, xv = _csr_padded_rows(x, x.shape[1] + 2)  # distinct sentinels never match
+    yi, yv = _csr_padded_rows(y, x.shape[1] + 1)
+    m, n = x.shape[0], y.shape[0]
+    p = jnp.float32(p)
+    outs = []
+    for s in range(0, m, pair_block):
+        row = []
+        for t in range(0, n, pair_block):
+            row.append(
+                _union_block(
+                    xi[s : s + pair_block], xv[s : s + pair_block],
+                    yi[t : t + pair_block], yv[t : t + pair_block],
+                    kind, use_max, p,
+                )
+            )
+        outs.append(jnp.concatenate(row, axis=1) if len(row) > 1 else row[0])
+    return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+
 def sparse_gram(x: CSR, y: CSR, transform=None, pair_block: int = 512) -> jax.Array:
     """Dense [m, n] gram ``X @ Y^T`` of two CSR matrices WITHOUT
     densifying the feature axis. ``transform`` optionally maps values
@@ -128,12 +222,36 @@ def pairwise_distance_sparse_native(
     y: CSR,
     metric=DistanceType.L2Expanded,
     pair_block: int = 512,
+    metric_arg: float = 2.0,
 ) -> jax.Array:
-    """Expanded-form metrics straight from CSR (``sparse/distance/
-    distance.cuh:69`` for the inner-product family) — never materializes
-    a dense feature axis, so arbitrarily wide matrices work."""
+    """Native-CSR metrics (``sparse/distance/distance.cuh:69``) — never
+    materializes a dense feature axis, so arbitrarily wide matrices work.
+    The gram family (inner product, cosine, L2, hellinger, jaccard, dice)
+    reduces to the sort-merge gram + row stats; the |a-b| family (L1,
+    Linf, Canberra, Lp, unexpanded L2, Hamming, BrayCurtis) uses the same
+    machinery with a union-of-nonzeros accumulation (the reference's
+    load-balanced CSR walkers, ``detail/lp_distance.cuh``)."""
     metric = resolve_metric(metric)
     expects(metric in _NATIVE, "metric %s has no native CSR path", metric)
+    if metric in _NATIVE_UNION:
+        d_cols = x.shape[1]
+        if metric == DistanceType.L1:
+            return _union_accumulate(x, y, "l1", pair_block=pair_block)
+        if metric == DistanceType.Linf:
+            return _union_accumulate(x, y, "linf", use_max=True, pair_block=pair_block)
+        if metric == DistanceType.Canberra:
+            return _union_accumulate(x, y, "canberra", pair_block=pair_block)
+        if metric == DistanceType.LpUnexpanded:
+            acc = _union_accumulate(x, y, "lp", p=metric_arg, pair_block=pair_block)
+            return acc ** (1.0 / metric_arg)
+        if metric in (DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded):
+            acc = _union_accumulate(x, y, "lp", p=2.0, pair_block=pair_block)
+            return jnp.sqrt(acc) if metric == DistanceType.L2SqrtUnexpanded else acc
+        if metric == DistanceType.HammingUnexpanded:
+            return _union_accumulate(x, y, "hamming", pair_block=pair_block) / d_cols
+        bc = _union_accumulate(x, y, "bc", pair_block=pair_block)  # braycurtis
+        num, den = bc[..., 0], bc[..., 1]
+        return jnp.where(den == 0.0, 0.0, num / jnp.where(den == 0.0, 1.0, den))
     if metric == DistanceType.HellingerExpanded:
         g = sparse_gram(x, y, transform=jnp.sqrt, pair_block=pair_block)
         return jnp.sqrt(jnp.maximum(1.0 - g, 0.0))
@@ -179,7 +297,7 @@ def pairwise_distance_sparse(
     expects(x.shape[1] == y.shape[1], "feature dim mismatch")
     expects(mode in ("auto", "densify", "native"), "bad mode %r", mode)
     if mode == "native" or (mode == "auto" and x.shape[1] > (1 << 18) and metric in _NATIVE):
-        return pairwise_distance_sparse_native(x, y, metric)
+        return pairwise_distance_sparse_native(x, y, metric, metric_arg=metric_arg)
     m = x.shape[0]
     x_rows = x.row_ids()
     y_rows = y.row_ids()
@@ -226,7 +344,7 @@ def knn_sparse(
 
     expects(mode in ("auto", "densify", "native"), "bad mode %r", mode)
     if mode == "native" or (mode == "auto" and x.shape[1] > (1 << 18) and metric in _NATIVE):
-        d = pairwise_distance_sparse_native(x, y, metric)
+        d = pairwise_distance_sparse_native(x, y, metric, metric_arg=metric_arg)
         return select_k(d, k, select_min=select_min)
 
     x_rows = x.row_ids()

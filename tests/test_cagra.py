@@ -242,3 +242,38 @@ class TestVpq:
         v1, i1 = cagra.search(comp, Q, 5)
         v2, i2 = cagra.search(loaded, Q, 5)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_plan_search_params_by_batch_shape():
+    """search_plan.cuh:81-164 analog: tiny batches get a wide low-latency
+    plan (fewer sequential iterations), big batches keep the batched
+    schedule, explicit overrides are respected."""
+    p1 = cagra.plan_search_params(1, 10, 1_000_000)
+    pbig = cagra.plan_search_params(1024, 10, 1_000_000)
+    assert p1.search_width >= 8
+    assert pbig.search_width == CagraSearchParams().search_width
+    _, _, it1, _ = cagra.derive_search_config(p1, 10, 1_000_000)
+    _, _, itb, _ = cagra.derive_search_config(pbig, 10, 1_000_000)
+    assert it1 < itb
+    pexp = cagra.plan_search_params(
+        1, 10, 100, CagraSearchParams(search_width=16, init_sample=64)
+    )
+    assert pexp.search_width == 16 and pexp.init_sample == 64
+    # an explicitly NARROW beam must survive too (only defaults are raised)
+    pnarrow = cagra.plan_search_params(1, 10, 100, CagraSearchParams(search_width=2))
+    assert pnarrow.search_width == 2
+
+
+def test_plan_latency_search_works(rng=None):
+    rng = np.random.default_rng(5)
+    X = _data(rng, 3000, 16, n_centers=10)
+    Q = _data(rng, 4, 16, n_centers=10)
+    index = cagra.build(
+        X, cagra.CagraIndexParams(intermediate_graph_degree=16, graph_degree=8, seed=0)
+    )
+    sp = cagra.plan_search_params(Q.shape[0], 5, 3000)
+    v, i = cagra.search(index, Q, 5, sp)
+    bf = brute_force.build(X, metric=DistanceType.L2Expanded)
+    _, gi = brute_force.search(bf, Q, 5)
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(gi)))
+    assert rec >= 0.8, rec

@@ -39,7 +39,7 @@ def test_fused_matches_brute_force_small_ksub(pq_bits):
         ds,
         ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=pq_bits, seed=3),
     )
-    assert idx.packed == (pq_bits == 4)
+    assert idx.packed  # pq_dim=16: every width 4/5/6 is byte-aligned
     v, i = ivf_pq.search(
         idx, qs, k,
         ivf_pq.IvfPqSearchParams(n_probes=16, fused_qt=16, fused_probe_factor=16, fused_group=4),
@@ -53,6 +53,109 @@ def test_fused_matches_brute_force_small_ksub(pq_bits):
     v2, i2 = ivf_pq.search(idx, qs, k, ivf_pq.IvfPqSearchParams(n_probes=16), mode="scan")
     rec2 = float(neighborhood_recall(np.asarray(i2), _gt(ds, qs, k)))
     assert abs(rec - rec2) < 0.08, (rec, rec2)
+
+
+@pytest.mark.parametrize("pq_bits", [3, 5, 6, 7])
+def test_bit_packed_roundtrip_and_size(pq_bits):
+    """Spanning bit-pack layouts (VERDICT r4 item 6): exact round-trip,
+    codes measurably smaller than one byte per code."""
+    rng = np.random.default_rng(0)
+    codes = rng.integers(0, 1 << pq_bits, (3, 7, 16), dtype=np.uint8)
+    packed = ivf_pq.pack_codes_bits(jnp.asarray(codes), pq_bits)
+    assert packed.shape[-1] == 16 * pq_bits // 8  # 6 / 10 / 12 / 14 bytes
+    out = ivf_pq.unpack_codes_bits(packed, pq_bits, 16)
+    np.testing.assert_array_equal(np.asarray(out), codes)
+
+
+@pytest.mark.parametrize("pq_bits", [3, 5, 6])
+def test_bit_packed_fused_matches_unpacked(pq_bits):
+    """The b3/b5/b6 kernel unpack decodes the same one-hots as u8 on the
+    unpacked bytes — results must be identical, index pq_bits/8 the
+    size."""
+    import dataclasses
+
+    ds, qs = _data(seed=7)
+    k = 10
+    idx = ivf_pq.build(
+        ds, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=pq_bits, seed=3)
+    )
+    assert idx.packed and idx.codes.shape[-1] == 16 * pq_bits // 8
+    unpacked = dataclasses.replace(idx, codes=idx.codes_unpacked(), packed=False)
+    sp = ivf_pq.IvfPqSearchParams(n_probes=16, fused_qt=16, fused_probe_factor=16, fused_group=4)
+    v, i = ivf_pq.search(idx, qs, k, sp, mode="fused")
+    v2, i2 = ivf_pq.search(unpacked, qs, k, sp, mode="fused")
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(v), np.asarray(v2), rtol=1e-5, atol=1e-5)
+
+
+def test_bit_packed_serialize_roundtrip():
+    ds, qs = _data(seed=8, n=1200, nq=16)
+    idx = ivf_pq.build(
+        ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=5, seed=3)
+    )
+    assert idx.packed
+    buf = io.BytesIO()
+    ivf_pq.save(idx, buf)
+    buf.seek(0)
+    idx2 = ivf_pq.load(buf)
+    assert idx2.packed and idx2.pq_bits == 5 and idx2.pq_dim == 16
+    sp = ivf_pq.IvfPqSearchParams(n_probes=8, fused_qt=16, fused_probe_factor=8, fused_group=2)
+    v, i = ivf_pq.search(idx, qs, 5, sp, mode="fused")
+    v2, i2 = ivf_pq.search(idx2, qs, 5, sp, mode="fused")
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
+
+
+def test_bit_packed_extend_repacks():
+    ds, qs = _data(seed=9, n=1500, nq=16)
+    idx = ivf_pq.build(
+        ds[:1000], ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=6, seed=3)
+    )
+    assert idx.packed
+    idx2 = ivf_pq.extend(idx, ds[1000:])
+    assert idx2.packed and idx2.size == 1500
+    assert idx2.codes.shape[-1] == 16 * 6 // 8
+    v, i = ivf_pq.search(idx2, qs, 5, ivf_pq.IvfPqSearchParams(n_probes=8), mode="scan")
+    rec = float(neighborhood_recall(np.asarray(i), _gt(ds, qs, 5)))
+    assert rec > 0.5, rec
+
+
+def test_fused_default_ksub256_matches_scan():
+    """The DEFAULT config (pq_bits=8, kmeans codebooks, ksub=256) takes
+    the fused path via column-chunked decode (VERDICT r4 item 3)."""
+    ds, qs = _data(seed=11)
+    k = 10
+    idx = ivf_pq.build(
+        ds, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=8, seed=3)
+    )
+    assert not idx.packed and not idx.additive and idx.ksub == 256
+    sp = ivf_pq.IvfPqSearchParams(
+        n_probes=16, fused_qt=16, fused_probe_factor=16, fused_group=4,
+        fused_decode_cols=512,  # force several chunks (K = 16*256 = 4096)
+    )
+    v, i = ivf_pq.search(idx, qs, k, sp, mode="fused")
+    v2, i2 = ivf_pq.search(idx, qs, k, ivf_pq.IvfPqSearchParams(n_probes=16), mode="scan")
+    gt = _gt(ds, qs, k)
+    rec = float(neighborhood_recall(np.asarray(i), gt))
+    rec2 = float(neighborhood_recall(np.asarray(i2), gt))
+    assert abs(rec - rec2) < 0.08, (rec, rec2)
+    assert rec > 0.7, rec
+
+
+def test_bit_packed_b7_fused_matches_unpacked():
+    """7-bit spanning layout + ksub=128 chunked decode."""
+    import dataclasses
+
+    ds, qs = _data(seed=12)
+    k = 8
+    idx = ivf_pq.build(
+        ds, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=7, seed=3)
+    )
+    assert idx.packed and idx.codes.shape[-1] == 14 and idx.ksub == 128
+    unpacked = dataclasses.replace(idx, codes=idx.codes_unpacked(), packed=False)
+    sp = ivf_pq.IvfPqSearchParams(n_probes=16, fused_qt=16, fused_probe_factor=16, fused_group=4)
+    v, i = ivf_pq.search(idx, qs, k, sp, mode="fused")
+    v2, i2 = ivf_pq.search(unpacked, qs, k, sp, mode="fused")
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
 
 
 def test_fused_nibble_beats_pq4():

@@ -193,6 +193,67 @@ class TestSparseDistance:
                 ref[i, j] = float((xv[i][xi_pos] * yv[j][yj_pos]).sum())
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
+    def test_native_csr_union_metrics_match_dense(self, rng):
+        """The |a-b| family (union-of-nonzeros accumulation) on the native
+        path vs the dense engine (VERDICT r4 item 7)."""
+        from raft_tpu.ops.distance import pairwise_distance
+
+        xd = (rng.random((22, 48)) * (rng.random((22, 48)) < 0.3)).astype(np.float32)
+        yd = (rng.random((19, 48)) * (rng.random((19, 48)) < 0.3)).astype(np.float32)
+        x = sparse.csr_from_dense(xd)
+        y = sparse.csr_from_dense(yd)
+        for metric, arg in [
+            (DistanceType.L1, 2.0),
+            (DistanceType.Linf, 2.0),
+            (DistanceType.Canberra, 2.0),
+            (DistanceType.LpUnexpanded, 3.0),
+            (DistanceType.L2Unexpanded, 2.0),
+            (DistanceType.L2SqrtUnexpanded, 2.0),
+            (DistanceType.HammingUnexpanded, 2.0),
+            (DistanceType.BrayCurtis, 2.0),
+        ]:
+            ours = np.asarray(
+                sparse.pairwise_distance_sparse(x, y, metric, metric_arg=arg, mode="native")
+            )
+            ref = np.asarray(pairwise_distance(xd, yd, metric, arg))
+            np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5, err_msg=str(metric))
+
+    def test_native_csr_l1_too_wide_to_densify(self, rng):
+        """L1 on a 2^30-column matrix (union path, no densify possible)."""
+        d = 1 << 30
+        m, n, nnz_per_row = 24, 18, 10
+
+        def make(rows):
+            cols = np.stack(
+                [
+                    np.sort(rng.choice(1 << 20, size=nnz_per_row, replace=False))
+                    for _ in range(rows)
+                ]
+            ).astype(np.int64) * (d >> 20)
+            vals = rng.random((rows, nnz_per_row)).astype(np.float32)
+            indptr = np.arange(rows + 1) * nnz_per_row
+            return sparse.CSR(
+                indptr=jnp.asarray(indptr, jnp.int32),
+                indices=jnp.asarray(cols.reshape(-1), jnp.int32),
+                vals=jnp.asarray(vals.reshape(-1)),
+                shape=(rows, d),
+            ), cols, vals
+
+        x, xc, xv = make(m)
+        y, yc, yv = make(n)
+        got = np.asarray(
+            sparse.pairwise_distance_sparse(x, y, DistanceType.L1, mode="auto")
+        )
+        ref = np.zeros((m, n), np.float32)
+        for i in range(m):
+            for j in range(n):
+                common, xi_pos, yj_pos = np.intersect1d(xc[i], yc[j], return_indices=True)
+                both = np.abs(xv[i][xi_pos] - yv[j][yj_pos]).sum()
+                xonly = np.abs(np.delete(xv[i], xi_pos)).sum()
+                yonly = np.abs(np.delete(yv[j], yj_pos)).sum()
+                ref[i, j] = float(both + xonly + yonly)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
     def test_knn_sparse(self, rng):
         xd = (rng.random((30, 10)) * (rng.random((30, 10)) < 0.5)).astype(np.float32)
         x = sparse.csr_from_dense(xd)
