@@ -4,22 +4,27 @@
 haversine/euclidean metrics.
 
 TPU-first note. The GPU RBC accelerates by *skipping* distance
-computations via landmark triangle-inequality pruning — a win when each
-skipped pair saves warp work. On the MXU, dense tiles are so much faster
-than data-dependent branching that the pruned scan loses to a straight
-tiled scan at RBC's 2-3D scale; accordingly:
+computations via landmark triangle-inequality pruning inside a
+warp-level loop. TPUs can't branch per lane, but the same pruning maps
+to the probed-group pattern the IVF indexes use:
 
-* the index keeps the RBC *structure* — √n sampled landmarks, per-landmark
-  grouped layout, landmark radii — for API parity and for the eps-query
-  pruning mask, and
-* ``knn_query`` is an exact tiled scan (distances via
-  :func:`raft_tpu.ops.distance.pairwise_distance`, which includes
-  haversine) rather than a translation of the CUDA registers-and-warps
-  pruning loop; results are exact, matching the reference's guarantee.
+* the index stores the RBC structure — √n sampled landmarks, members
+  grouped per landmark in a padded ``[L, max_group]`` layout, landmark
+  radii;
+* ``knn_query(n_probes=p)`` scans groups in waves of the ``p``
+  landmark-nearest groups per query (one gather + batched distance per
+  wave), then applies the reference's **post-filtering rule**
+  (``ball_cover-inl.cuh:259``): a wave stops the search only when the
+  triangle-inequality lower bound ``d(q, lm_g) - radius_g`` of every
+  unscanned group exceeds the current k-th distance — so results stay
+  EXACT while clustered workloads touch a fraction of the points;
+* ``n_probes=0`` (default) keeps the dense tiled scan, which wins when
+  the data is small or uniform (MXU tiles beat gathers there).
 """
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Optional, Tuple
 
@@ -29,7 +34,6 @@ import numpy as np
 
 from raft_tpu.core.errors import expects
 from raft_tpu.ops.distance import DistanceType, pairwise_distance, resolve_metric
-from raft_tpu.ops.fused_1nn import min_cluster_and_distance
 from raft_tpu.ops.select_k import running_merge, select_k, worst_value
 
 _SUPPORTED = (
@@ -50,11 +54,19 @@ class BallCoverIndex:
     assignments: jax.Array  # [n] landmark of each row
     landmark_dists: jax.Array  # [n] distance to own landmark
     radii: jax.Array  # [n_landmarks] max member distance
+    group_rows: jax.Array  # [n_landmarks, max_group] i32 members, -1 pad
     metric: DistanceType
 
     def tree_flatten(self):
         return (
-            (self.dataset, self.landmarks, self.assignments, self.landmark_dists, self.radii),
+            (
+                self.dataset,
+                self.landmarks,
+                self.assignments,
+                self.landmark_dists,
+                self.radii,
+                self.group_rows,
+            ),
             (self.metric,),
         )
 
@@ -88,27 +100,115 @@ def build(dataset, metric=DistanceType.Haversine, n_landmarks: Optional[int] = N
     assignments = jnp.argmin(d_lm, axis=1).astype(jnp.int32)
     dists = jnp.take_along_axis(d_lm, assignments[:, None], axis=1)[:, 0]
     radii = jax.ops.segment_max(dists, assignments, num_segments=k)
+    # padded per-landmark member lists (host-side: one stable sort)
+    a_np = np.asarray(assignments)
+    counts = np.bincount(a_np, minlength=k)
+    mg = max(1, int(counts.max()))
+    order = np.argsort(a_np, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    within = np.arange(n) - starts[a_np[order]]
+    group_rows = np.full((k, mg), -1, np.int32)
+    group_rows[a_np[order], within] = order.astype(np.int32)
     return BallCoverIndex(
         dataset=dataset,
         landmarks=landmarks,
         assignments=assignments,
         landmark_dists=dists,
         radii=radii,
+        group_rows=jnp.asarray(group_rows),
         metric=metric,
     )
 
 
+def _gathered_distance(q, pts, metric):
+    """Distances between query n and its gathered candidates: ``q [nq, d]``
+    vs ``pts [nq, c, d]`` -> ``[nq, c]``."""
+    if metric == DistanceType.Haversine:
+        from raft_tpu.ops.distance import haversine_core
+
+        return haversine_core(q[:, 0:1], q[:, 1:2], pts[..., 0], pts[..., 1])
+    diff = q[:, None, :] - pts
+    d2 = jnp.sum(diff * diff, axis=-1)
+    if metric == DistanceType.L2Expanded:
+        return d2
+    return jnp.sqrt(jnp.maximum(d2, 0.0))
+
+
+def _triangle_lb(d_lm, radii, metric):
+    """Per-(query, group) lower bound on the distance to any group member.
+    Proper metrics: ``max(d(q, lm) - radius, 0)``. Squared L2 violates the
+    triangle inequality, so the bound is formed in sqrt space and squared
+    back (``ball_cover-inl.cuh:323`` restricts eps queries the same way)."""
+    if metric == DistanceType.L2Expanded:
+        s = jnp.sqrt(jnp.maximum(d_lm, 0.0)) - jnp.sqrt(jnp.maximum(radii, 0.0))[None, :]
+        s = jnp.maximum(s, 0.0)
+        return s * s
+    return jnp.maximum(d_lm - radii[None, :], 0.0)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_scan_wave(metric):
+    @jax.jit
+    def scan_wave(dataset, group_rows, queries, probe_ids, acc_v, acc_i):
+        nq = queries.shape[0]
+        rows = group_rows[probe_ids]  # [nq, p, mg]
+        rows_flat = rows.reshape(nq, -1)
+        valid = rows_flat >= 0
+        pts = dataset[jnp.clip(rows_flat, 0, None)]  # [nq, c, d]
+        worst = jnp.float32(worst_value(jnp.float32, True))
+        d = jnp.where(valid, _gathered_distance(queries, pts, metric), worst)
+        ids = jnp.where(valid, rows_flat, -1)
+        k = acc_v.shape[1]
+        if d.shape[1] > k:
+            d, ids = select_k(d, k, select_min=True, indices=ids)
+        return running_merge(acc_v, acc_i, d, ids, select_min=True)
+
+    return scan_wave
+
+
 def knn_query(
-    index: BallCoverIndex, queries, k: int, block: int = 8192
+    index: BallCoverIndex, queries, k: int, block: int = 8192, n_probes: int = 0
 ) -> Tuple[jax.Array, jax.Array]:
-    """Exact kNN (``rbc_knn_query``, ``ball_cover-inl.cuh:259``): tiled
-    scan + running top-k merge."""
+    """Exact kNN (``rbc_knn_query``, ``ball_cover-inl.cuh:259``).
+
+    ``n_probes=0``: dense tiled scan + running top-k merge.
+    ``n_probes=p``: landmark-pruned search — scan waves of the ``p``
+    landmark-nearest groups per query, stopping as soon as the triangle
+    inequality certifies no unscanned group can hold a closer point than
+    the current k-th (the reference's post-filtering pass). Exact either
+    way; the pruned path wins on clustered data where early waves already
+    contain the true neighbors."""
     queries = jnp.asarray(queries, jnp.float32)
     expects(queries.shape[1] == index.dataset.shape[1], "bad query shape")
     n = index.size
     expects(0 < k <= n, "k out of range")
     nq = queries.shape[0]
     worst = jnp.float32(worst_value(jnp.float32, True))
+    if n_probes > 0:
+        L = index.n_landmarks
+        p = min(n_probes, L)
+        d_lm = pairwise_distance(queries, index.landmarks, index.metric)  # [nq, L]
+        lb = _triangle_lb(d_lm, index.radii, index.metric)
+        order = jnp.argsort(d_lm, axis=1).astype(jnp.int32)  # nearest landmarks first
+        lb_ord = jnp.take_along_axis(lb, order, axis=1)
+        scan_wave = _make_scan_wave(index.metric)
+        acc_v = jnp.full((nq, k), worst, jnp.float32)
+        acc_i = jnp.full((nq, k), -1, jnp.int32)
+        scanned = 0
+        while scanned < L:
+            probe_ids = order[:, scanned : scanned + p]
+            acc_v, acc_i = scan_wave(
+                index.dataset, index.group_rows, queries, probe_ids, acc_v, acc_i
+            )
+            scanned += int(probe_ids.shape[1])
+            if scanned >= L:
+                break
+            # post-filter certificate: can any unscanned group beat the
+            # current k-th distance for any query?
+            beta = acc_v[:, k - 1]
+            if not bool(jnp.any(lb_ord[:, scanned:] <= beta[:, None])):
+                break
+        return acc_v, acc_i
     acc_v = jnp.full((nq, k), worst, jnp.float32)
     acc_i = jnp.full((nq, k), -1, jnp.int32)
     for s in range(0, n, block):
@@ -138,15 +238,7 @@ def eps_query(
     ``(sqrt(d_lm) - sqrt(radius))^2 > eps``."""
     queries = jnp.asarray(queries, jnp.float32)
     d_lm = pairwise_distance(queries, index.landmarks, index.metric)  # [nq, L]
-    if index.metric == DistanceType.L2Expanded:
-        lb = jnp.maximum(
-            jnp.sqrt(jnp.maximum(d_lm, 0.0))
-            - jnp.sqrt(jnp.maximum(index.radii, 0.0))[None, :],
-            0.0,
-        )
-        group_ok = (lb * lb) <= eps  # [nq, L]
-    else:
-        group_ok = (d_lm - index.radii[None, :]) <= eps  # [nq, L]
+    group_ok = _triangle_lb(d_lm, index.radii, index.metric) <= eps  # [nq, L]
     d = pairwise_distance(queries, index.dataset, index.metric)  # [nq, n]
     adj = (d < eps) & group_ok[:, index.assignments]
     vd = jnp.sum(adj, axis=1, dtype=jnp.int32)

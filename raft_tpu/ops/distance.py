@@ -365,15 +365,20 @@ def _accum_distance(x: jax.Array, y: jax.Array, metric: DistanceType, p: float) 
     return _accum_finalize(acc, metric, p, d)
 
 
-def _haversine(x: jax.Array, y: jax.Array) -> jax.Array:
-    """Great-circle distance for 2-D (lat, lon in radians) points
-    (``spatial/knn/detail/haversine_distance.cuh``)."""
-    x1, x2 = x[:, 0:1], x[:, 1:2]
-    y1, y2 = y[None, :, 0], y[None, :, 1]
-    sin_0 = jnp.sin(0.5 * (x1 - y1))
-    sin_1 = jnp.sin(0.5 * (x2 - y2))
-    rdist = sin_0 * sin_0 + jnp.cos(x1) * jnp.cos(y1) * sin_1 * sin_1
+def haversine_core(lat1, lon1, lat2, lon2) -> jax.Array:
+    """Great-circle distance from broadcast-compatible (lat, lon in
+    radians) components (``spatial/knn/detail/haversine_distance.cuh``).
+    Shared by the pairwise engine and the ball-cover gathered path — keep
+    the formula in exactly one place."""
+    sin_0 = jnp.sin(0.5 * (lat1 - lat2))
+    sin_1 = jnp.sin(0.5 * (lon1 - lon2))
+    rdist = sin_0 * sin_0 + jnp.cos(lat1) * jnp.cos(lat2) * sin_1 * sin_1
     return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(rdist, 0.0, 1.0)))
+
+
+def _haversine(x: jax.Array, y: jax.Array) -> jax.Array:
+    """[m, n] pairwise haversine."""
+    return haversine_core(x[:, 0:1], x[:, 1:2], y[None, :, 0], y[None, :, 1])
 
 
 # ---------------------------------------------------------------------------

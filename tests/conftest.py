@@ -7,6 +7,15 @@ kernels run in interpreter mode on CPU (handled inside the library).
 """
 import os
 
+# Single-thread the native math runtimes BEFORE any of them load: the
+# suite ends up with XLA, torch (transitively), and sklearn's OpenMP in
+# one process, and their competing thread pools both thrash the (often
+# single-core) CI box and can SEGFAULT on teardown/first-use races
+# (observed: flaky segv in stats entropy right after sklearn import).
+os.environ.setdefault("OMP_NUM_THREADS", "1")
+os.environ.setdefault("OPENBLAS_NUM_THREADS", "1")
+os.environ.setdefault("MKL_NUM_THREADS", "1")
+
 # XLA_FLAGS must be set before the CPU backend initializes. The platform
 # itself is forced via jax.config below — the environment may pin
 # JAX_PLATFORMS to a TPU plugin (e.g. axon) at interpreter start, which
@@ -21,6 +30,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+# Persistent compile cache: the suite's wall-clock is dominated by XLA
+# compiles (one per unique program; hundreds across the suite). A warm
+# cache cuts repeat runs several-fold on 1-2 core boxes.
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/jax_comp_tests")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

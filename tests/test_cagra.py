@@ -84,11 +84,11 @@ class TestOptimize:
 
 class TestCagraSearch:
     def test_recall_nn_descent_build(self, rng):
-        n, d, nq, k = 4000, 32, 64, 10
+        n, d, nq, k = 2500, 32, 64, 10
         X = _data(rng, n, d)
         Q = _data(rng, nq, d)
         index = cagra.build(
-            X, CagraIndexParams(intermediate_graph_degree=48, graph_degree=24, seed=0)
+            X, CagraIndexParams(intermediate_graph_degree=48, graph_degree=24, nn_descent_niter=8, seed=0)
         )
         _, ref = brute_force.search(brute_force.build(X), Q, k)
         _, ann = cagra.search(index, Q, k, CagraSearchParams(itopk_size=64, search_width=2))
@@ -96,7 +96,7 @@ class TestCagraSearch:
         assert recall >= 0.9, f"recall {recall}"
 
     def test_recall_ivf_pq_build(self, rng):
-        n, d, nq, k = 3000, 32, 48, 10
+        n, d, nq, k = 2000, 32, 48, 10
         X = _data(rng, n, d)
         Q = _data(rng, nq, d)
         index = cagra.build(
@@ -114,7 +114,7 @@ class TestCagraSearch:
         assert recall >= 0.85, f"recall {recall}"
 
     def test_inner_product(self, rng):
-        n, d, nq, k = 3000, 32, 48, 10
+        n, d, nq, k = 2000, 32, 48, 10
         X = _data(rng, n, d)
         X /= np.linalg.norm(X, axis=1, keepdims=True)
         Q = _data(rng, nq, d)
@@ -141,7 +141,7 @@ class TestCagraSearch:
         X = _data(rng, n, d)
         Q = _data(rng, nq, d)
         index = cagra.build(
-            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=3)
+            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=3)
         )
         banned = np.arange(0, n, 2, dtype=np.int32)
         bs = Bitset.create(n, default=True).unset(banned)
@@ -161,7 +161,7 @@ class TestCagraSearch:
         X = _data(rng, n, d)
         Q = _data(rng, nq, d)
         index = cagra.build(
-            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=5)
+            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=5)
         )
         allowed = np.arange(0, n, 20, dtype=np.int32)  # 5% allowed
         bs = Bitset.create(n, default=False).set(allowed)
@@ -178,7 +178,7 @@ class TestCagraSearch:
         X = _data(rng, n, d)
         Q = _data(rng, nq, d)
         index = cagra.build(
-            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=4)
+            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=4)
         )
         # round trip with dataset
         buf = io.BytesIO()
@@ -201,14 +201,15 @@ class TestCagraSearch:
 class TestVpq:
     """VPQ-compressed dataset (``neighbors/dataset.hpp:210-259``)."""
 
+    @pytest.mark.slow
     def test_compressed_search_recall(self, rng):
         n, d, nq, k = 3000, 32, 64, 10
         X = _data(rng, n, d, n_centers=16, scale=0.2)
         Q = _data(rng, nq, d, n_centers=16, scale=0.2)
         index = cagra.build(
-            X, cagra.CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=0)
+            X, cagra.CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=0)
         )
-        comp = cagra.compress(index, cagra.VpqParams(pq_dim=8, seed=1))
+        comp = cagra.compress(index, cagra.VpqParams(pq_dim=8, pq_bits=6, kmeans_n_iters=6, seed=1))
         assert comp.dataset is None and comp.vpq is not None
         assert comp.vpq.codes.shape == (n, 8)
         _, ref = brute_force.search(
@@ -226,12 +227,12 @@ class TestVpq:
     def test_vpq_serialize_roundtrip(self, rng):
         import io as _io
 
-        n, d = 1500, 16
+        n, d = 1000, 16
         X = _data(rng, n, d, n_centers=8)
         index = cagra.build(
-            X, cagra.CagraIndexParams(intermediate_graph_degree=16, graph_degree=8, seed=0)
+            X, cagra.CagraIndexParams(intermediate_graph_degree=16, graph_degree=8, nn_descent_niter=6, seed=0)
         )
-        comp = cagra.compress(index, cagra.VpqParams(pq_dim=4, seed=1))
+        comp = cagra.compress(index, cagra.VpqParams(pq_dim=4, pq_bits=6, kmeans_n_iters=6, seed=1))
         buf = _io.BytesIO()
         cagra.save(comp, buf)
         buf.seek(0)
@@ -266,12 +267,12 @@ def test_plan_search_params_by_batch_shape():
 
 def test_plan_latency_search_works(rng=None):
     rng = np.random.default_rng(5)
-    X = _data(rng, 3000, 16, n_centers=10)
+    X = _data(rng, 1500, 16, n_centers=10)
     Q = _data(rng, 4, 16, n_centers=10)
     index = cagra.build(
-        X, cagra.CagraIndexParams(intermediate_graph_degree=16, graph_degree=8, seed=0)
+        X, cagra.CagraIndexParams(intermediate_graph_degree=16, graph_degree=8, nn_descent_niter=6, seed=0)
     )
-    sp = cagra.plan_search_params(Q.shape[0], 5, 3000)
+    sp = cagra.plan_search_params(Q.shape[0], 5, 1500)
     v, i = cagra.search(index, Q, 5, sp)
     bf = brute_force.build(X, metric=DistanceType.L2Expanded)
     _, gi = brute_force.search(bf, Q, 5)

@@ -147,8 +147,8 @@ class TestFindK:
         from raft_tpu.random import make_blobs
 
         k_true = 5
-        X, _, _ = make_blobs(3, 600, 8, n_clusters=k_true, cluster_std=0.05)
-        best_k, inertia, n_iter = find_k(np.asarray(X), kmax=10, kmin=2)
+        X, _, _ = make_blobs(3, 300, 8, n_clusters=k_true, cluster_std=0.05)
+        best_k, inertia, n_iter = find_k(np.asarray(X), kmax=8, kmin=2, max_iter=25)
         assert best_k == k_true, best_k
         assert float(inertia) >= 0
 

@@ -67,6 +67,54 @@ class TestBallCover:
         ref_i = np.argsort(d2, axis=1)[:, :4]
         assert float(neighborhood_recall(np.asarray(i), ref_i)) >= 0.999
 
+    def test_knn_pruned_matches_exact(self, rng):
+        """Landmark-pruned waves + post-filter certificate stay EXACT
+        (ball_cover-inl.cuh:259 post-filtering rule) across metrics."""
+        for metric, make in (
+            (DistanceType.Haversine, lambda: _geo(rng, 700)),
+            (DistanceType.L2SqrtExpanded, lambda: rng.standard_normal((700, 3)).astype(np.float32)),
+            (DistanceType.L2Expanded, lambda: rng.standard_normal((700, 2)).astype(np.float32)),
+        ):
+            X = make()
+            Q = X[:25] + 0.01 * rng.standard_normal((25, X.shape[1])).astype(np.float32)
+            index = ball_cover.build(X, metric=metric)
+            dv, iv = ball_cover.knn_query(index, Q, 5)
+            dp, ip = ball_cover.knn_query(index, Q, 5, n_probes=4)
+            np.testing.assert_array_equal(np.asarray(ip), np.asarray(iv), err_msg=str(metric))
+            # distances: the dense path uses the expanded form
+            # (||x||^2+||y||^2-2xy), the gathered path sums (x-y)^2
+            # directly — identical ranking, ~1e-4 rounding skew
+            np.testing.assert_allclose(np.asarray(dp), np.asarray(dv), rtol=2e-4, atol=2e-4)
+
+    def test_knn_pruned_clustered_early_stop(self, rng):
+        """On tightly clustered data the first wave's k-th distance beats
+        every far group's lower bound — the certificate must fire well
+        before all landmarks are scanned (the point of RBC)."""
+        centers = rng.standard_normal((8, 2)).astype(np.float32) * 50
+        X = (centers[rng.integers(0, 8, 900)] + 0.1 * rng.standard_normal((900, 2))).astype(np.float32)
+        Q = X[:16]
+        index = ball_cover.build(X, metric=DistanceType.L2SqrtUnexpanded, seed=1)
+        waves = {"n": 0}
+        orig = ball_cover._make_scan_wave.__wrapped__(DistanceType.L2SqrtUnexpanded)
+
+        def counting(metric):
+            def run(*a):
+                waves["n"] += 1
+                return orig(*a)
+            return run
+
+        ball_cover._make_scan_wave.cache_clear()
+        real = ball_cover._make_scan_wave
+        try:
+            ball_cover._make_scan_wave = counting
+            _, ip = ball_cover.knn_query(index, Q, 5, n_probes=4)
+        finally:
+            ball_cover._make_scan_wave = real
+        L = index.n_landmarks
+        assert waves["n"] * 4 < L, (waves, L)  # pruned: far groups never scanned
+        _, iv = ball_cover.knn_query(index, Q, 5)
+        np.testing.assert_array_equal(np.asarray(ip), np.asarray(iv))
+
     def test_eps_query_exact_despite_pruning(self, rng):
         X = _geo(rng, 500)
         Q = _geo(rng, 30)

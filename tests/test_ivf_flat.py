@@ -27,7 +27,7 @@ def data():
 @pytest.fixture(scope="module")
 def index(data):
     dataset, _ = data
-    return ivf_flat.build(dataset, IvfFlatIndexParams(n_lists=64, metric=DistanceType.L2Expanded, seed=0))
+    return ivf_flat.build(dataset, IvfFlatIndexParams(kmeans_n_iters=5, n_lists=64, metric=DistanceType.L2Expanded, seed=0))
 
 
 def exact(dataset, queries, k, metric=DistanceType.L2Expanded):
@@ -38,7 +38,7 @@ def exact(dataset, queries, k, metric=DistanceType.L2Expanded):
 def test_recall_at_probes(data, index):
     dataset, queries = data
     _, ref_idx = exact(dataset, queries, K)
-    dist, idx = ivf_flat.search(index, queries, K, IvfFlatSearchParams(n_probes=32))
+    dist, idx = ivf_flat.search(index, queries, K, IvfFlatSearchParams(n_probes=40))
     recall = float(neighborhood_recall(np.asarray(idx), np.asarray(ref_idx)))
     assert recall >= 0.95, recall
 
@@ -169,7 +169,7 @@ def test_ivf_flat_integer_dtypes(rng, dtype):
     lo, hi = (0, 60) if dtype == np.uint8 else (-30, 30)
     X = rng.integers(lo, hi, (n, d)).astype(dtype)
     Q = rng.integers(lo, hi, (nq, d)).astype(dtype)
-    index = ivf_flat.build(X, IvfFlatIndexParams(n_lists=32, seed=1))
+    index = ivf_flat.build(X, IvfFlatIndexParams(kmeans_n_iters=5, n_lists=32, seed=1))
     assert index.list_data.dtype == dtype
     from raft_tpu.neighbors import brute_force as bf_mod
 

@@ -33,7 +33,7 @@ class TestIvfPqBuild:
     def test_shapes_and_packing(self, rng):
         n, d = 2000, 32
         X = _clustered(rng, n, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=16, pq_dim=8, seed=1))
+        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=16, pq_dim=8, seed=1))
         assert index.pq_dim == 8
         assert index.ksub == 256
         assert index.rot_dim == 32
@@ -56,7 +56,7 @@ class TestIvfPqBuild:
     def test_rotation_orthonormal_when_padding(self, rng):
         n, d = 500, 30  # 30 not divisible by pq_dim=8 -> rot_dim=32, random R
         X = _clustered(rng, n, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=0))
+        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, seed=0))
         R = np.asarray(index.rotation)
         assert R.shape == (32, 30)
         # isometry on the input space: ||R x|| == ||x|| for all x in R^30
@@ -70,7 +70,7 @@ class TestIvfPqSearch:
         X = _clustered(rng, n, d)
         Q = _clustered(rng, nq, d)
         index = ivf_pq.build(
-            X, IvfPqIndexParams(n_lists=32, pq_dim=16, codebook_kind=codebook_kind, seed=2)
+            X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=32, pq_dim=16, codebook_kind=codebook_kind, seed=2)
         )
         _, ref_i = _exact(X, Q, k)
         _, ann_i = ivf_pq.search(index, Q, k, IvfPqSearchParams(n_probes=16))
@@ -83,7 +83,7 @@ class TestIvfPqSearch:
         n, d, nq, k = 6000, 32, 64, 10
         X = _clustered(rng, n, d)
         Q = _clustered(rng, nq, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=32, pq_dim=8, seed=3))
+        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=32, pq_dim=8, seed=3))
         _, ref_i = _exact(X, Q, k)
         # over-fetch 4x then exact re-rank (the reference's refine pattern)
         _, cand = ivf_pq.search(index, Q, 4 * k, IvfPqSearchParams(n_probes=32))
@@ -97,7 +97,7 @@ class TestIvfPqSearch:
         X /= np.linalg.norm(X, axis=1, keepdims=True)
         Q = _clustered(rng, nq, d)
         index = ivf_pq.build(
-            X, IvfPqIndexParams(n_lists=16, pq_dim=16, metric=DistanceType.InnerProduct, seed=4)
+            X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=16, pq_dim=16, metric=DistanceType.InnerProduct, seed=4)
         )
         _, ref_i = _exact(X, Q, k, metric=DistanceType.InnerProduct)
         _, ann_i = ivf_pq.search(index, Q, k, IvfPqSearchParams(n_probes=12))
@@ -108,9 +108,9 @@ class TestIvfPqSearch:
         n, d, nq, k = 2000, 16, 16, 5
         X = _clustered(rng, n, d)
         Q = _clustered(rng, nq, d)
-        i1 = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=5))
+        i1 = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, seed=5))
         i2 = ivf_pq.build(
-            X, IvfPqIndexParams(n_lists=8, pq_dim=8, metric=DistanceType.L2SqrtExpanded, seed=5)
+            X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, metric=DistanceType.L2SqrtExpanded, seed=5)
         )
         v1, idx1 = ivf_pq.search(i1, Q, k, n_probes=8)
         v2, idx2 = ivf_pq.search(i2, Q, k, n_probes=8)
@@ -125,7 +125,7 @@ class TestIvfPqSearch:
         n, d, nq, k = 3000, 32, 32, 10
         X = _clustered(rng, n, d)
         Q = _clustered(rng, nq, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=16, pq_dim=16, seed=6))
+        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=16, pq_dim=16, seed=6))
         _, ref_i = _exact(X, Q, k)
         _, ann_i = ivf_pq.search(
             index, Q, k, IvfPqSearchParams(n_probes=16, lut_dtype=jnp.bfloat16)
@@ -141,7 +141,7 @@ class TestIvfPqSearch:
         n, d, nq, k = 2000, 16, 16, 5
         X = _clustered(rng, n, d)
         Q = _clustered(rng, nq, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=7))
+        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, seed=7))
         banned = np.arange(0, n, 2, dtype=np.int32)  # ban all even ids
         bs = Bitset.create(n, default=True).unset(banned)
         _, idx = ivf_pq.search(index, Q, k, n_probes=8, prefilter=bs)
@@ -153,7 +153,7 @@ class TestIvfPqSearch:
         n, d, nq, k = 1500, 16, 24, 5
         X = _clustered(rng, n, d, n_centers=8)
         Q = _clustered(rng, nq, d, n_centers=8)
-        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=4, pq_dim=16, seed=8))
+        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=4, pq_dim=16, seed=8))
         _, ref_i = _exact(X, Q, k)
         _, ann_i = ivf_pq.search(index, Q, k, n_probes=4)
         recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
@@ -165,7 +165,7 @@ class TestIvfPqExtendSerialize:
         n, d = 2000, 16
         X = _clustered(rng, n, d)
         X2 = _clustered(rng, 500, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=9))
+        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, seed=9))
         bigger = ivf_pq.extend(index, X2)
         assert bigger.size == n + 500
         ids = np.asarray(bigger.list_indices)
@@ -180,7 +180,7 @@ class TestIvfPqExtendSerialize:
         n, d, nq, k = 1500, 16, 8, 5
         X = _clustered(rng, n, d)
         Q = _clustered(rng, nq, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(n_lists=8, pq_dim=8, seed=10))
+        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, seed=10))
         buf = io.BytesIO()
         ivf_pq.save(index, buf)
         buf.seek(0)

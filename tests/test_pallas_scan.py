@@ -41,7 +41,7 @@ def _data(n=2000, d=32, nq=100, n_centers=20, seed=0):
 def test_fused_all_probes_matches_brute_force(metric):
     ds, qs = _data()
     k = 10
-    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=16, metric=metric, seed=1))
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(kmeans_n_iters=5, n_lists=16, metric=metric, seed=1))
     assert idx.center_rank is not None
     v, i = ivf_flat_fused_search(
         idx.centers,
@@ -73,7 +73,7 @@ def test_fused_all_probes_matches_brute_force(metric):
 def test_fused_seg_merge_vs_probe_path(metric):
     ds, qs = _data(seed=2)
     k = 10
-    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=16, metric=metric, seed=1))
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(kmeans_n_iters=5, n_lists=16, metric=metric, seed=1))
     v, i = ivf_flat.search(
         idx,
         qs,
@@ -88,7 +88,7 @@ def test_fused_seg_merge_vs_probe_path(metric):
 
 def test_fused_ragged_batch_and_tiny_k():
     ds, qs = _data(nq=37, seed=3)  # not a multiple of the tile height
-    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=1))
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(kmeans_n_iters=5, n_lists=8, seed=1))
     v, i = ivf_flat.search(
         idx,
         qs,
@@ -107,7 +107,7 @@ def test_fused_prefilter():
 
     ds, qs = _data(seed=4)
     k = 5
-    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=1))
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(kmeans_n_iters=5, n_lists=8, seed=1))
     # filter out the exact top-1 of every query, fused must return the rest
     bf = brute_force.build(ds, metric=DistanceType.L2Expanded)
     _, bi = brute_force.search(bf, qs, 1)
@@ -131,7 +131,7 @@ def test_fused_prefilter():
 
 def test_center_rank_serialization_roundtrip():
     ds, _ = _data(n=500, seed=5)
-    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=1))
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(kmeans_n_iters=5, n_lists=8, seed=1))
     buf = io.BytesIO()
     ivf_flat.save(idx, buf)
     buf.seek(0)
@@ -158,7 +158,7 @@ def test_fused_int8_lists():
     ds = rng.integers(-30, 30, (1500, 32)).astype(np.int8)
     qs = rng.integers(-30, 30, (64, 32)).astype(np.int8)
     k = 5
-    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=1))
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(kmeans_n_iters=5, n_lists=8, seed=1))
     v, i = ivf_flat.search(
         idx,
         qs,
@@ -183,7 +183,7 @@ def test_fused_legacy_index_without_spatial_order():
 
     ds, qs = _data(seed=8)
     k = 5
-    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=16, seed=1))
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(kmeans_n_iters=5, n_lists=16, seed=1))
     perm = np.random.default_rng(3).permutation(idx.n_lists)
     legacy = dataclasses.replace(
         idx,
@@ -218,7 +218,7 @@ def test_fused_legacy_rank_not_identity_forces_group1():
     from raft_tpu.neighbors.ivf_flat import _legacy_rank_cache, _rank_is_identity
 
     ds, _ = _data(seed=9)
-    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(n_lists=16, seed=1))
+    idx = ivf_flat.build(ds, ivf_flat.IvfFlatIndexParams(kmeans_n_iters=5, n_lists=16, seed=1))
     # v3 build: identity rank -> spatial order derived True
     assert _rank_is_identity(idx.center_rank)
     perm = np.random.default_rng(4).permutation(idx.n_lists)

@@ -17,7 +17,7 @@ from raft_tpu.ops.distance import DistanceType
 from raft_tpu.stats import neighborhood_recall
 
 
-def _data(seed=0, n=4000, d=32, nq=128):
+def _data(seed=0, n=2500, d=32, nq=128):
     rng = np.random.default_rng(seed)
     centers = rng.standard_normal((20, d)).astype(np.float32) * 3
     ds = centers[rng.integers(0, 20, n)] + rng.standard_normal((n, d)).astype(np.float32)
@@ -31,13 +31,13 @@ def _gt(ds, qs, k, metric=DistanceType.L2Expanded):
     return np.asarray(bi)
 
 
-@pytest.mark.parametrize("pq_bits", [4, 5, 6])
+@pytest.mark.parametrize("pq_bits", [4, pytest.param(5, marks=pytest.mark.slow), pytest.param(6, marks=pytest.mark.slow)])
 def test_fused_matches_brute_force_small_ksub(pq_bits):
     ds, qs = _data(seed=1)
     k = 10
     idx = ivf_pq.build(
         ds,
-        ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=pq_bits, seed=3),
+        ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=16, pq_dim=16, pq_bits=pq_bits, seed=3),
     )
     assert idx.packed  # pq_dim=16: every width 4/5/6 is byte-aligned
     v, i = ivf_pq.search(
@@ -67,7 +67,7 @@ def test_bit_packed_roundtrip_and_size(pq_bits):
     np.testing.assert_array_equal(np.asarray(out), codes)
 
 
-@pytest.mark.parametrize("pq_bits", [3, 5, 6])
+@pytest.mark.parametrize("pq_bits", [pytest.param(3, marks=pytest.mark.slow), pytest.param(5, marks=pytest.mark.slow), 6])
 def test_bit_packed_fused_matches_unpacked(pq_bits):
     """The b3/b5/b6 kernel unpack decodes the same one-hots as u8 on the
     unpacked bytes — results must be identical, index pq_bits/8 the
@@ -77,7 +77,7 @@ def test_bit_packed_fused_matches_unpacked(pq_bits):
     ds, qs = _data(seed=7)
     k = 10
     idx = ivf_pq.build(
-        ds, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=pq_bits, seed=3)
+        ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=16, pq_dim=16, pq_bits=pq_bits, seed=3)
     )
     assert idx.packed and idx.codes.shape[-1] == 16 * pq_bits // 8
     unpacked = dataclasses.replace(idx, codes=idx.codes_unpacked(), packed=False)
@@ -91,7 +91,7 @@ def test_bit_packed_fused_matches_unpacked(pq_bits):
 def test_bit_packed_serialize_roundtrip():
     ds, qs = _data(seed=8, n=1200, nq=16)
     idx = ivf_pq.build(
-        ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=5, seed=3)
+        ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=16, pq_bits=5, seed=3)
     )
     assert idx.packed
     buf = io.BytesIO()
@@ -105,10 +105,11 @@ def test_bit_packed_serialize_roundtrip():
     np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
 
 
+@pytest.mark.slow
 def test_bit_packed_extend_repacks():
     ds, qs = _data(seed=9, n=1500, nq=16)
     idx = ivf_pq.build(
-        ds[:1000], ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=6, seed=3)
+        ds[:1000], ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=16, pq_bits=6, seed=3)
     )
     assert idx.packed
     idx2 = ivf_pq.extend(idx, ds[1000:])
@@ -125,7 +126,7 @@ def test_fused_default_ksub256_matches_scan():
     ds, qs = _data(seed=11)
     k = 10
     idx = ivf_pq.build(
-        ds, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=8, seed=3)
+        ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=16, pq_dim=16, pq_bits=8, seed=3)
     )
     assert not idx.packed and not idx.additive and idx.ksub == 256
     sp = ivf_pq.IvfPqSearchParams(
@@ -141,6 +142,7 @@ def test_fused_default_ksub256_matches_scan():
     assert rec > 0.7, rec
 
 
+@pytest.mark.slow
 def test_bit_packed_b7_fused_matches_unpacked():
     """7-bit spanning layout + ksub=128 chunked decode."""
     import dataclasses
@@ -148,7 +150,7 @@ def test_bit_packed_b7_fused_matches_unpacked():
     ds, qs = _data(seed=12)
     k = 8
     idx = ivf_pq.build(
-        ds, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=7, seed=3)
+        ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=16, pq_dim=16, pq_bits=7, seed=3)
     )
     assert idx.packed and idx.codes.shape[-1] == 14 and idx.ksub == 128
     unpacked = dataclasses.replace(idx, codes=idx.codes_unpacked(), packed=False)
@@ -158,12 +160,13 @@ def test_bit_packed_b7_fused_matches_unpacked():
     np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
 
 
+@pytest.mark.slow
 def test_fused_nibble_beats_pq4():
     ds, qs = _data(seed=2)
     k = 10
     common = dict(n_lists=16, pq_dim=16, seed=3)
-    idx4 = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(pq_bits=4, **common))
-    idx_nib = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(pq_bits=8, pq_kind="nibble", **common))
+    idx4 = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, pq_bits=4, **common))
+    idx_nib = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, pq_bits=8, pq_kind="nibble", **common))
     assert idx_nib.additive and not idx_nib.packed
     sp = ivf_pq.IvfPqSearchParams(n_probes=16, fused_qt=16, fused_probe_factor=16, fused_group=4)
     _, i4 = ivf_pq.search(idx4, qs, k, sp, mode="fused")
@@ -180,7 +183,7 @@ def test_fused_inner_product():
     k = 8
     idx = ivf_pq.build(
         ds,
-        ivf_pq.IvfPqIndexParams(
+        ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, 
             n_lists=16, pq_dim=16, pq_bits=8, pq_kind="nibble",
             metric=DistanceType.InnerProduct, seed=5,
         ),
@@ -199,7 +202,7 @@ def test_fused_prefilter():
 
     ds, qs = _data(seed=6)
     k = 5
-    idx = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=6, seed=7))
+    idx = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=16, pq_bits=6, seed=7))
     banned = np.arange(0, ds.shape[0], 2)
     bs = Bitset.from_unset_indices(ds.shape[0], jnp.asarray(banned, jnp.int32))
     _, i = ivf_pq.search(
@@ -214,7 +217,7 @@ def test_fused_prefilter():
 
 def test_packed_codes_round_trip():
     ds, _ = _data(seed=8)
-    idx = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=4, seed=9))
+    idx = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=16, pq_bits=4, seed=9))
     assert idx.packed
     assert idx.codes.shape[2] == 8  # pq_dim/2 bytes per row
     up = ivf_pq.unpack_codes(idx.codes)
@@ -225,8 +228,8 @@ def test_packed_codes_round_trip():
 
 def test_packed_index_smaller_than_8bit():
     ds, _ = _data(seed=8)
-    idx4 = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=4, seed=9))
-    idx8 = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=8, seed=9))
+    idx4 = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=16, pq_bits=4, seed=9))
+    idx8 = ivf_pq.build(ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=16, pq_bits=8, seed=9))
     b4 = io.BytesIO()
     b8 = io.BytesIO()
     ivf_pq.save(idx4, b4)
@@ -240,7 +243,7 @@ def test_serialize_v3_round_trip_nibble():
     ds, qs = _data(seed=10)
     k = 5
     idx = ivf_pq.build(
-        ds, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=8, pq_kind="nibble", seed=11)
+        ds, ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=16, pq_bits=8, pq_kind="nibble", seed=11)
     )
     buf = io.BytesIO()
     ivf_pq.save(idx, buf)
@@ -255,8 +258,8 @@ def test_serialize_v3_round_trip_nibble():
 
 def test_extend_packed():
     ds, qs = _data(seed=12)
-    idx = ivf_pq.build(ds[:3000], ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, pq_bits=4, seed=13))
-    idx2 = ivf_pq.extend(idx, ds[3000:])
+    idx = ivf_pq.build(ds[:2000], ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=16, pq_bits=4, seed=13))
+    idx2 = ivf_pq.extend(idx, ds[2000:])
     assert idx2.size == ds.shape[0]
     assert idx2.packed and idx2.codes.shape[2] == 8
     _, i = ivf_pq.search(
@@ -264,4 +267,54 @@ def test_extend_packed():
         ivf_pq.IvfPqSearchParams(n_probes=8, fused_qt=16, fused_probe_factor=8, fused_group=2),
         mode="fused",
     )
-    assert int(np.asarray(i).max()) >= 3000  # extended rows are findable
+    assert int(np.asarray(i).max()) >= 2000  # extended rows are findable
+
+
+def test_multi_hot_decode_every_width():
+    """Fast kernel-math coverage for ALL code layouts (u8, p4, nib8,
+    b3/b5/b6/b7): _multi_hot's decode must reproduce the one-hot of the
+    unpacked codes — this guards the spanning bit arithmetic without an
+    index build, so the heavy end-to-end variants can sit behind -m slow."""
+    from raft_tpu.ops.pallas.pq_scan import _code_groups, _multi_hot
+
+    rng = np.random.default_rng(3)
+    m, pq_dim = 6, 16
+    for bits in (3, 5, 6, 7):
+        ksub = 1 << bits
+        codes = rng.integers(0, ksub, (m, pq_dim), dtype=np.uint8)
+        packed = np.asarray(ivf_pq.pack_codes_bits(jnp.asarray(codes), bits))
+        bpr = packed.shape[-1]
+        mode = f"b{bits}"
+        n_groups, gw = _code_groups(mode, ksub, bpr)
+        assert (n_groups, gw) == (pq_dim, ksub)
+        s = np.asarray(
+            _multi_hot(jnp.asarray(packed), code_mode=mode, ksub=ksub, m=m, bpr=bpr)
+        )
+        expect = np.zeros((m, pq_dim * ksub), np.float32)
+        for r in range(m):
+            for j in range(pq_dim):
+                expect[r, j * ksub + int(codes[r, j])] = 1.0
+        np.testing.assert_array_equal(s.astype(np.float32), expect, err_msg=mode)
+        # chunked decode (the ksub-256-style path) agrees column-for-column
+        half = pq_dim // 2
+        s0 = np.asarray(
+            _multi_hot(jnp.asarray(packed), code_mode=mode, ksub=ksub, m=m, bpr=bpr,
+                       g0=half, ng=half)
+        )
+        np.testing.assert_array_equal(s0, s[:, half * ksub:], err_msg=mode + " chunk")
+    # u8 / p4 / nib8 byte layouts
+    codes = rng.integers(0, 64, (m, pq_dim), dtype=np.uint8)
+    s = np.asarray(_multi_hot(jnp.asarray(codes), code_mode="u8", ksub=64, m=m, bpr=pq_dim))
+    expect = np.zeros((m, pq_dim * 64), np.float32)
+    for r in range(m):
+        for j in range(pq_dim):
+            expect[r, j * 64 + int(codes[r, j])] = 1.0
+    np.testing.assert_array_equal(s.astype(np.float32), expect, err_msg="u8")
+    codes4 = rng.integers(0, 16, (m, pq_dim), dtype=np.uint8)
+    p4 = np.asarray(ivf_pq.pack_codes(jnp.asarray(codes4)))
+    s = np.asarray(_multi_hot(jnp.asarray(p4), code_mode="p4", ksub=16, m=m, bpr=pq_dim // 2))
+    expect = np.zeros((m, pq_dim * 16), np.float32)
+    for r in range(m):
+        for j in range(pq_dim):
+            expect[r, j * 16 + int(codes4[r, j])] = 1.0
+    np.testing.assert_array_equal(s.astype(np.float32), expect, err_msg="p4")

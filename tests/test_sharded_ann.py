@@ -29,7 +29,7 @@ def _data(rng, n, d, nc=32, scale=0.25):
 @pytest.fixture(scope="module")
 def setup(eight_devices):
     rng = np.random.default_rng(3)
-    n, d, nq = 4096, 32, 64
+    n, d, nq = 2048, 32, 64
     X = _data(rng, n, d)
     Q = _data(rng, nq, d)
     mesh = make_mesh(eight_devices)
@@ -59,11 +59,12 @@ class TestShardedIvfFlat:
 
 
 class TestShardedCagra:
+    @pytest.mark.slow
     def test_matches_unsharded(self, setup):
         mesh, X, Q = setup
         k = 8
         index = cagra.build(
-            X, cagra.CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=0)
+            X, cagra.CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=0)
         )
         sv, si = sharded_cagra_search(
             mesh, index, Q, k, cagra.CagraSearchParams(itopk_size=64, search_width=2)
@@ -113,6 +114,7 @@ class TestShardedIvfPq:
         rec = float(neighborhood_recall(np.asarray(si), np.asarray(ui)))
         assert rec >= 0.95, rec
 
+    @pytest.mark.slow
     def test_distributed_build_sketch(self, setup):
         """psum-Lloyd coarse + codebook training over row-sharded data."""
         from raft_tpu.parallel.sharded_ann import sharded_ivf_pq_build
@@ -130,13 +132,14 @@ class TestShardedIvfPq:
 
 
 class TestShardedCagraVpq:
+    @pytest.mark.slow
     def test_vpq_index_works_sharded(self, setup):
         mesh, X, Q = setup
         k = 8
         index = cagra.build(
-            X, cagra.CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, seed=0)
+            X, cagra.CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=0)
         )
-        comp = cagra.compress(index, cagra.VpqParams(pq_dim=8, seed=1))
+        comp = cagra.compress(index, cagra.VpqParams(pq_dim=8, kmeans_n_iters=6, seed=1))
         sv, si = sharded_cagra_search(
             mesh, comp, Q, k, cagra.CagraSearchParams(itopk_size=64, search_width=2)
         )

@@ -195,27 +195,34 @@ class TestSparseDistance:
 
     def test_native_csr_union_metrics_match_dense(self, rng):
         """The |a-b| family (union-of-nonzeros accumulation) on the native
-        path vs the dense engine (VERDICT r4 item 7)."""
-        from raft_tpu.ops.distance import pairwise_distance
-
+        path vs direct numpy formulas (matching the dense engine's
+        definitions in ops/distance.py, VERDICT r4 item 7)."""
         xd = (rng.random((22, 48)) * (rng.random((22, 48)) < 0.3)).astype(np.float32)
         yd = (rng.random((19, 48)) * (rng.random((19, 48)) < 0.3)).astype(np.float32)
         x = sparse.csr_from_dense(xd)
         y = sparse.csr_from_dense(yd)
-        for metric, arg in [
-            (DistanceType.L1, 2.0),
-            (DistanceType.Linf, 2.0),
-            (DistanceType.Canberra, 2.0),
-            (DistanceType.LpUnexpanded, 3.0),
-            (DistanceType.L2Unexpanded, 2.0),
-            (DistanceType.L2SqrtUnexpanded, 2.0),
-            (DistanceType.HammingUnexpanded, 2.0),
-            (DistanceType.BrayCurtis, 2.0),
-        ]:
+        diff = np.abs(xd[:, None, :] - yd[None, :, :])
+        add = np.abs(xd[:, None, :]) + np.abs(yd[None, :, :])
+        refs = {
+            DistanceType.L1: diff.sum(-1),
+            DistanceType.Linf: diff.max(-1),
+            DistanceType.Canberra: np.where(add == 0, 0, diff / np.where(add == 0, 1, add)).sum(-1),
+            DistanceType.LpUnexpanded: (diff**3).sum(-1) ** (1 / 3),
+            DistanceType.L2Unexpanded: (diff**2).sum(-1),
+            DistanceType.L2SqrtUnexpanded: np.sqrt((diff**2).sum(-1)),
+            DistanceType.HammingUnexpanded: (xd[:, None, :] != yd[None, :, :]).sum(-1) / 48,
+            DistanceType.BrayCurtis: np.where(
+                np.abs(xd[:, None, :] + yd[None, :, :]).sum(-1) == 0, 0,
+                diff.sum(-1) / np.where(
+                    np.abs(xd[:, None, :] + yd[None, :, :]).sum(-1) == 0, 1,
+                    np.abs(xd[:, None, :] + yd[None, :, :]).sum(-1)),
+            ),
+        }
+        for metric, ref in refs.items():
+            arg = 3.0 if metric == DistanceType.LpUnexpanded else 2.0
             ours = np.asarray(
                 sparse.pairwise_distance_sparse(x, y, metric, metric_arg=arg, mode="native")
             )
-            ref = np.asarray(pairwise_distance(xd, yd, metric, arg))
             np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5, err_msg=str(metric))
 
     def test_native_csr_l1_too_wide_to_densify(self, rng):

@@ -2,6 +2,9 @@
 1M x 128. Run EXCLUSIVELY on the TPU: python tools/sweep_cagra.py
 """
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import numpy as np
@@ -57,8 +60,12 @@ def main():
         ),
     )
     float(jnp.sum(cidx.graph[0].astype(jnp.float32)))
-    print(f"# ivf_pq-path build: {time.perf_counter()-t0:.1f}s", flush=True)
+    build_s = round(time.perf_counter() - t0, 1)
+    print(f"# ivf_pq-path build: {build_s}s", flush=True)
 
+    from _artifact import Recorder
+
+    art = Recorder("sweep_cagra", {"n": N, "dim": D, "nq": NQ, "k": K})
     print(f"# {'config':44s} {'qps':>10s} {'recall':>8s}")
     for itopk, w, dedup in [
         (128, 4, "sort"),
@@ -80,6 +87,22 @@ def main():
             continue
         rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt))
         print(f"# {tag:44s} {NQ/dt:>10,.0f} {rec:>8.4f}", flush=True)
+        art.add({"config": tag, "qps": round(NQ / dt, 1), "recall": round(rec, 4)})
+
+    # small-batch latency rows (plan_search_params schedule)
+    for bq in (1, 10):
+        sp = cagra.plan_search_params(bq, K, N, cagra.CagraSearchParams(itopk_size=128, dedup="post"))
+        try:
+            dt, (v, i) = timed(lambda sp=sp, bq=bq: cagra.search(cidx, queries[:bq], K, sp))
+        except Exception as e:  # noqa: BLE001
+            print(f"# latency batch={bq} FAILED {type(e).__name__}", flush=True)
+            continue
+        rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt[:bq]))
+        print(f"# latency batch={bq:<3d} {dt*1e3:8.2f} ms  recall={rec:.4f}", flush=True)
+        art.add({"config": f"latency batch={bq} w={sp.search_width}",
+                 "latency_ms": round(dt * 1e3, 2), "recall": round(rec, 4)})
+
+    art.set_context(build_seconds=build_s, device=str(jax.devices()[0]))
 
 
 if __name__ == "__main__":

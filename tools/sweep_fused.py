@@ -11,6 +11,8 @@ nprobe) config. Uses the same synthetic clustered data as bench.py.
 import dataclasses
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import numpy as np
@@ -93,6 +95,9 @@ def main():
             ],
         }
 
+    from _artifact import Recorder
+
+    art = Recorder("sweep_fused", {"n": N, "dim": D, "nq": NQ, "k": K, "mode": mode})
     print(f"# {'config':60s} {'qps':>10s} {'recall':>8s}")
     for cap, configs in plans.items():
         t0 = time.perf_counter()
@@ -127,6 +132,9 @@ def main():
                 continue
             rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt))
             print(f"# {tag:60s} {NQ/dt:>10,.0f} {rec:>8.4f}", flush=True)
+            art.add({"config": tag, "qps": round(NQ / dt, 1), "recall": round(rec, 4)})
+
+    art.set_context(device=str(jax.devices()[0]))
 
 
 if __name__ == "__main__":

@@ -3,6 +3,9 @@
 Run EXCLUSIVELY on the TPU. Usage: python tools/sweep_pq.py
 """
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import numpy as np
@@ -71,6 +74,9 @@ def main():
         print(f"# build {name}: {time.perf_counter()-t0:.1f}s  codes={code_mb:.0f}MB "
               f"max_list={idxs[name].max_list}", flush=True)
 
+    from _artifact import Recorder
+
+    art = Recorder("sweep_pq", {"n": N, "dim": D, "nq": NQ, "k": K})
     print(f"# {'config':52s} {'qps':>10s} {'recall':>8s}")
     for name, npr, pf, g, rr in [
         ("p4_d32", 30, 32, 8, 4),
@@ -100,6 +106,9 @@ def main():
             continue
         rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt))
         print(f"# {tag:52s} {NQ/dt:>10,.0f} {rec:>8.4f}", flush=True)
+        art.add({"config": tag, "qps": round(NQ / dt, 1), "recall": round(rec, 4)})
+
+    art.set_context(device=str(jax.devices()[0]))
 
 
 if __name__ == "__main__":
