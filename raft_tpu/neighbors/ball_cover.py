@@ -177,8 +177,20 @@ def knn_query(
     inequality certifies no unscanned group can hold a closer point than
     the current k-th (the reference's post-filtering pass). Exact either
     way; the pruned path wins on clustered data where early waves already
-    contain the true neighbors."""
+    contain the true neighbors.
+
+    The pruned path decides how many waves to run from DATA (the
+    certificate), so it is a host-side loop of jitted waves — call it
+    outside ``jax.jit`` (the dense path traces fine)."""
     queries = jnp.asarray(queries, jnp.float32)
+    if n_probes > 0 and (
+        isinstance(queries, jax.core.Tracer) or isinstance(index.dataset, jax.core.Tracer)
+    ):
+        raise TypeError(
+            "ball_cover.knn_query(n_probes>0) runs a data-dependent host "
+            "loop (the post-filter certificate) and cannot be traced under "
+            "jax.jit; call it outside jit, or use n_probes=0 (dense scan)"
+        )
     expects(queries.shape[1] == index.dataset.shape[1], "bad query shape")
     n = index.size
     expects(0 < k <= n, "k out of range")

@@ -282,24 +282,9 @@ def _accum_step(xc: jax.Array, yc: jax.Array, metric: DistanceType, p: float):
     if metric == DistanceType.HammingUnexpanded:
         return jnp.sum((xb != yb).astype(jnp.float32), axis=-1)
     if metric == DistanceType.KLDivergence:
-        # x * (log x - log y), zero-guarded (distance_ops/kl_divergence.cuh)
-        x_zero = xb == 0.0
-        y_zero = yb == 0.0
-        lx = jnp.log(jnp.where(x_zero, 1.0, xb))
-        ly = jnp.where(y_zero, 0.0, jnp.log(jnp.where(y_zero, 1.0, yb)))
-        return jnp.sum(xb * (lx - ly), axis=-1)
+        return jnp.sum(kl_term(xb, yb), axis=-1)
     if metric == DistanceType.JensenShannon:
-        # -x*(log m - log x) - y*(log m - log y), m = (x+y)/2
-        # (distance_ops/jensen_shannon.cuh)
-        mb = 0.5 * (xb + yb)
-        m_zero = mb == 0.0
-        log_m = jnp.where(m_zero, 0.0, jnp.log(jnp.where(m_zero, 1.0, mb)))
-        x_zero = xb == 0.0
-        y_zero = yb == 0.0
-        lx = jnp.log(jnp.where(x_zero, 1.0, xb) + 0.0)
-        ly = jnp.log(jnp.where(y_zero, 1.0, yb) + 0.0)
-        term = -xb * (log_m - lx) - yb * (log_m - ly)
-        return jnp.sum(term, axis=-1)
+        return jnp.sum(js_term(xb, yb), axis=-1)
     raise AssertionError(f"not an accumulation metric: {metric}")
 
 
@@ -363,6 +348,29 @@ def _accum_distance(x: jax.Array, y: jax.Array, metric: DistanceType, p: float) 
 
     acc, _ = lax.scan(body, init, (xcs, ycs))
     return _accum_finalize(acc, metric, p, d)
+
+
+def kl_term(a, b) -> jax.Array:
+    """Elementwise ``a * (log a - log b)``, zero-guarded exactly as the
+    reference's functor (``distance_ops/kl_divergence.cuh``): a==0 terms
+    vanish, b==0 drops the log-b contribution. Shared by the dense
+    accumulation engine and the sparse union path — keep the guards in
+    exactly one place."""
+    la = jnp.log(jnp.where(a == 0.0, 1.0, a))
+    lb = jnp.where(b == 0.0, 0.0, jnp.log(jnp.where(b == 0.0, 1.0, b)))
+    return a * (la - lb)
+
+
+def js_term(a, b) -> jax.Array:
+    """Elementwise Jensen-Shannon contribution ``-a*(log m - log a) -
+    b*(log m - log b)`` with ``m = (a+b)/2``, zero-guarded
+    (``distance_ops/jensen_shannon.cuh``). Finalize with
+    ``sqrt(max(0.5 * sum, 0))``. Shared like :func:`kl_term`."""
+    m = 0.5 * (a + b)
+    lm = jnp.where(m == 0.0, 0.0, jnp.log(jnp.where(m == 0.0, 1.0, m)))
+    la = jnp.log(jnp.where(a == 0.0, 1.0, a))
+    lb = jnp.log(jnp.where(b == 0.0, 1.0, b))
+    return -a * (lm - la) - b * (lm - lb)
 
 
 def haversine_core(lat1, lon1, lat2, lon2) -> jax.Array:

@@ -29,7 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.errors import expects
-from raft_tpu.ops.distance import DistanceType, pairwise_distance, resolve_metric
+from raft_tpu.ops.distance import (
+    DistanceType,
+    js_term,
+    kl_term,
+    pairwise_distance,
+    resolve_metric,
+)
 from raft_tpu.ops.select_k import running_merge, select_k, worst_value
 from raft_tpu.sparse.types import CSR
 
@@ -60,6 +66,8 @@ _NATIVE_UNION = frozenset(
         DistanceType.L2SqrtUnexpanded,
         DistanceType.HammingUnexpanded,
         DistanceType.BrayCurtis,
+        DistanceType.KLDivergence,
+        DistanceType.JensenShannon,
     }
 )
 _NATIVE = _NATIVE_GRAM | _NATIVE_UNION
@@ -129,6 +137,11 @@ def _union_block(xi, xv, yi, yv, kind, use_max, p):
         if kind == "canberra":
             den = jnp.abs(a) + jnp.abs(b)
             return jnp.where(den > 0.0, ad / jnp.where(den > 0.0, den, 1.0), 0.0)
+        if kind == "kl":
+            # (0, b) terms vanish, so the union's y-only side is free
+            return kl_term(a, b)
+        if kind == "js":
+            return js_term(a, b)
         return (a != b).astype(jnp.float32)  # hamming
 
     def one_y(yrow_i, yrow_v):
@@ -249,6 +262,11 @@ def pairwise_distance_sparse_native(
             return jnp.sqrt(acc) if metric == DistanceType.L2SqrtUnexpanded else acc
         if metric == DistanceType.HammingUnexpanded:
             return _union_accumulate(x, y, "hamming", pair_block=pair_block) / d_cols
+        if metric == DistanceType.KLDivergence:
+            return _union_accumulate(x, y, "kl", pair_block=pair_block)
+        if metric == DistanceType.JensenShannon:
+            acc = _union_accumulate(x, y, "js", pair_block=pair_block)
+            return jnp.sqrt(jnp.maximum(0.5 * acc, 0.0))
         bc = _union_accumulate(x, y, "bc", pair_block=pair_block)  # braycurtis
         num, den = bc[..., 0], bc[..., 1]
         return jnp.where(den == 0.0, 0.0, num / jnp.where(den == 0.0, 1.0, den))

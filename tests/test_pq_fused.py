@@ -183,7 +183,7 @@ def test_fused_inner_product():
     k = 8
     idx = ivf_pq.build(
         ds,
-        ivf_pq.IvfPqIndexParams(kmeans_n_iters=5, 
+        ivf_pq.IvfPqIndexParams(kmeans_n_iters=5,
             n_lists=16, pq_dim=16, pq_bits=8, pq_kind="nibble",
             metric=DistanceType.InnerProduct, seed=5,
         ),

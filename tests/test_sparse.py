@@ -201,8 +201,12 @@ class TestSparseDistance:
         yd = (rng.random((19, 48)) * (rng.random((19, 48)) < 0.3)).astype(np.float32)
         x = sparse.csr_from_dense(xd)
         y = sparse.csr_from_dense(yd)
-        diff = np.abs(xd[:, None, :] - yd[None, :, :])
-        add = np.abs(xd[:, None, :]) + np.abs(yd[None, :, :])
+        xb, yb = xd[:, None, :], yd[None, :, :]
+        diff = np.abs(xb - yb)
+        add = np.abs(xb) + np.abs(yb)
+        mix = 0.5 * (xb + yb)
+        guarded_log = lambda v: np.where(v == 0, 0, np.log(np.where(v == 0, 1, v)))  # noqa: E731
+        lm, lx, ly = guarded_log(mix), guarded_log(xb), guarded_log(yb)
         refs = {
             DistanceType.L1: diff.sum(-1),
             DistanceType.Linf: diff.max(-1),
@@ -211,6 +215,14 @@ class TestSparseDistance:
             DistanceType.L2Unexpanded: (diff**2).sum(-1),
             DistanceType.L2SqrtUnexpanded: np.sqrt((diff**2).sum(-1)),
             DistanceType.HammingUnexpanded: (xd[:, None, :] != yd[None, :, :]).sum(-1) / 48,
+            # x*(log x - log y), with x==0 terms vanishing and y==0
+            # dropping the log-y contribution (the dense engine's guards)
+            DistanceType.KLDivergence: (
+                xb * (np.where(xb == 0, 0, lx) - ly)
+            ).sum(-1),
+            DistanceType.JensenShannon: np.sqrt(np.maximum(
+                0.5 * (-xb * (lm - lx) - yb * (lm - ly)).sum(-1), 0.0
+            )),
             DistanceType.BrayCurtis: np.where(
                 np.abs(xd[:, None, :] + yd[None, :, :]).sum(-1) == 0, 0,
                 diff.sum(-1) / np.where(
