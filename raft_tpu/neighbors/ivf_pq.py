@@ -142,7 +142,10 @@ class IvfPqSearchParams:
     fused_merge: str = "bank8"
     fused_extract_every: int = 0
     # max multi-hot columns materialized per decode chunk (VMEM bound for
-    # wide codebooks: K = pq_dim * ksub columns total); 0 = single pass
+    # wide codebooks: K = pq_dim * ksub columns total); 0 = single pass.
+    # Always further capped by a VMEM model of the kernel
+    # (pq_scan.vmem_decode_cols) so long lists cannot blow the ~16 MB
+    # scoped-VMEM stack.
     fused_decode_cols: int = 2048
 
 
@@ -1112,6 +1115,21 @@ def search(
         and (index.additive or index.ksub <= 256)
         and index.metric in _SUPPORTED
     )
+    if fused_ok:
+        # very long lists with wide codebooks cannot fit even one decode
+        # group in VMEM — auto must route them to the scan path
+        from raft_tpu.ops.pallas.pq_scan import decode_feasible
+
+        if index.additive or (index.packed and index.pq_bits == 4):
+            _cm, _ks = ("nib8" if index.additive else "p4"), 16
+        elif index.packed:
+            _cm, _ks = f"b{index.pq_bits}", index.ksub
+        else:
+            _cm, _ks = "u8", index.ksub
+        fused_ok = decode_feasible(
+            m=index.codes.shape[1], code_mode=_cm, ksub=_ks,
+            bpr=index.codes.shape[2],
+        )
     # the fused kernel's LUT is bf16 by construction; an explicit float32
     # request is a precision demand auto must honor via the scan path
     wants_f32_lut = (
@@ -1128,9 +1146,14 @@ def search(
     )
 
     if mode == "fused":
-        from raft_tpu.ops.pallas.pq_scan import ivf_pq_fused_search
+        from raft_tpu.ops.pallas.pq_scan import ivf_pq_fused_search, vmem_decode_cols
 
-        expects(fused_ok, "fused mode needs per_subspace + (ksub<=256 | nibble)")
+        expects(
+            fused_ok,
+            "fused mode needs per_subspace + (ksub<=256 | nibble) + a "
+            "VMEM-feasible list length (long lists with wide codebooks "
+            "must use mode='scan' or more n_lists)",
+        )
         if index.additive:
             books, code_mode, ksub = nibble_books(index.pq_centers), "nib8", 16
         elif index.packed and index.pq_bits == 4:
@@ -1180,7 +1203,15 @@ def search(
                 code_mode=code_mode,
                 ksub=ksub,
                 extract_every=params.fused_extract_every,
-                decode_cols=params.fused_decode_cols,
+                # VMEM-model cap: wide-codebook decode chunks must fit
+                # the ~16 MB scoped-VMEM stack at any list length
+                decode_cols=vmem_decode_cols(
+                    params.fused_decode_cols,
+                    m=index.codes.shape[1],
+                    code_mode=code_mode,
+                    ksub=ksub,
+                    bpr=index.codes.shape[2],
+                ),
                 interpret=jax.default_backend() != "tpu",
             )
 

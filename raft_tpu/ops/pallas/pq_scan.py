@@ -153,6 +153,56 @@ def _multi_hot(cod, *, code_mode: str, ksub: int, m: int, bpr: int,
     return (val == sub16).astype(jnp.bfloat16)
 
 
+_DECODE_CHUNK_BUDGET = 8_000_000  # bytes of scoped VMEM for one decode chunk
+
+
+def _decode_cell_bytes(code_mode: str) -> int:
+    """Peak live bytes per (row, column) of a decode chunk. u8/nib8/p4
+    hold the f32 byte-spread + the bf16 multi-hot (~6 B); the spanning
+    bit layouts keep TWO f32 byte-spreads (low/high byte) plus f32 peel
+    temps live at once (~14 B)."""
+    return 14 if code_mode.startswith("b") and code_mode[1:].isdigit() else 6
+
+
+def decode_feasible(*, m: int, code_mode: str, ksub: int, bpr: int) -> bool:
+    """Whether even a single-group decode chunk fits the VMEM budget —
+    false for very long lists with wide codebooks (e.g. ksub=256 with
+    max_list > ~5200), where the fused kernel cannot compile and callers
+    must use the scan path instead."""
+    _, gw = _code_groups(code_mode, ksub, bpr)
+    return _decode_cell_bytes(code_mode) * m * gw <= _DECODE_CHUNK_BUDGET
+
+
+def vmem_decode_cols(requested: int, *, m: int, code_mode: str, ksub: int,
+                     bpr: int) -> int:
+    """Cap the decode column chunk so the kernel's scoped-VMEM stack fits
+    the TPU's ~16 MB limit.
+
+    A chunk materializes the multi-hot ``S [m, Kc]`` bf16 plus f32
+    byte-spread intermediates (see :func:`_decode_cell_bytes`). Measured
+    at the 1M-row bench shape (m=1152, ksub=256, Kc=2048) the kernel
+    needs 17.19 MB and the Mosaic compile dies at 16 MB; capping the
+    chunk to an ~8 MB budget leaves room for the fixed residents (W
+    tile, bank scratch, double-buffered code DMA, dot accumulators)
+    with margin. Chunks cover whole code groups, so the cap rounds down
+    to a multiple of the group width. Raises when even one group cannot
+    fit (use :func:`decode_feasible` to route such shapes to the scan
+    path up front)."""
+    n_groups, gw = _code_groups(code_mode, ksub, bpr)
+    K = n_groups * gw
+    if not requested:
+        requested = K
+    expects(
+        decode_feasible(m=m, code_mode=code_mode, ksub=ksub, bpr=bpr),
+        "fused PQ decode infeasible: one %d-column group over %d rows "
+        "exceeds the VMEM chunk budget — use mode='scan' or more lists",
+        gw, m,
+    )
+    cap = int(_DECODE_CHUNK_BUDGET // (_decode_cell_bytes(code_mode) * max(m, 1)))
+    cap = max(gw, (cap // gw) * gw)
+    return min(requested, cap, K)
+
+
 def _make_pq_kernel(*, k, metric, merge, qt, m, g_lists, n_steps, K,
                     code_mode, ksub, bpr, extract_every, decode_cols):
     banks = _eff_banks(merge, m, 0)

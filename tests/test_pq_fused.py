@@ -318,3 +318,34 @@ def test_multi_hot_decode_every_width():
         for j in range(pq_dim):
             expect[r, j * 16 + int(codes4[r, j])] = 1.0
     np.testing.assert_array_equal(s.astype(np.float32), expect, err_msg="p4")
+
+
+def test_vmem_decode_cols_cap():
+    """The VMEM model keeps the decode chunk under budget for any list
+    length (the 1M bench shape m=1152, ksub=256 exceeded the 16 MB
+    scoped-VMEM stack before the cap existed)."""
+    from raft_tpu.ops.pallas.pq_scan import vmem_decode_cols
+
+    # bench shape: requested 2048 must shrink to a whole-group multiple
+    dc = vmem_decode_cols(2048, m=1152, code_mode="u8", ksub=256, bpr=32)
+    assert dc % 256 == 0 and dc < 2048
+    assert 6 * 1152 * dc <= 8_000_000
+    # short lists keep the request
+    assert vmem_decode_cols(2048, m=256, code_mode="u8", ksub=256, bpr=32) == 2048
+    # 0 = "single pass" still resolves to a bounded chunk
+    dc0 = vmem_decode_cols(0, m=1152, code_mode="u8", ksub=256, bpr=32)
+    assert dc0 == dc
+    # lists too long for even one group are infeasible: flagged up front
+    # (ivf_pq.search auto-routes those to the scan path) and refused here
+    from raft_tpu.core.errors import RaftError
+    from raft_tpu.ops.pallas.pq_scan import decode_feasible
+
+    assert not decode_feasible(m=100_000, code_mode="u8", ksub=256, bpr=32)
+    with pytest.raises(RaftError):
+        vmem_decode_cols(2048, m=100_000, code_mode="u8", ksub=256, bpr=32)
+    # narrow layouts (nib8: 32 cols/group) are usually uncapped
+    assert vmem_decode_cols(1024, m=1152, code_mode="nib8", ksub=16, bpr=32) == 1024
+    # spanning bit layouts carry a heavier per-cell footprint (two f32
+    # byte-spreads + peel temps), so their cap is tighter than u8's
+    assert vmem_decode_cols(4096, m=1152, code_mode="b5", ksub=32, bpr=20) < \
+        vmem_decode_cols(4096, m=1152, code_mode="u8", ksub=32, bpr=32)
