@@ -252,10 +252,11 @@ def _run_tpu_subprocess(hard_s, attempt=1):
             sys.stdout.write(line)
             sys.stdout.flush()
             if line.lstrip().startswith("{"):
+                # only the bench's own result line counts — runtime libs
+                # can emit structured-JSON log lines on the merged stream
                 try:
-                    json.loads(line)
-                    saw_json[0] = True
-                except json.JSONDecodeError:
+                    saw_json[0] |= json.loads(line).get("metric") == METRIC
+                except (json.JSONDecodeError, AttributeError):
                     pass
 
     import threading

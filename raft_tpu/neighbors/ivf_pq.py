@@ -1065,6 +1065,21 @@ def scan_bf16(lut_dtype) -> bool:
     )
 
 
+
+def _fused_code_layout(index) -> tuple:
+    """(code_mode, ksub) the fused kernel would use for this index — the
+    ONE mapping shared by the VMEM feasibility gate and the fused call
+    (drift here would make auto-mode model feasibility with the wrong
+    layout)."""
+    if index.additive:
+        return "nib8", 16
+    if index.packed and index.pq_bits == 4:
+        return "p4", 16
+    if index.packed:
+        return f"b{index.pq_bits}", index.ksub
+    return "u8", index.ksub
+
+
 def search(
     index: IvfPqIndex,
     queries,
@@ -1120,12 +1135,7 @@ def search(
         # group in VMEM — auto must route them to the scan path
         from raft_tpu.ops.pallas.pq_scan import decode_feasible
 
-        if index.additive or (index.packed and index.pq_bits == 4):
-            _cm, _ks = ("nib8" if index.additive else "p4"), 16
-        elif index.packed:
-            _cm, _ks = f"b{index.pq_bits}", index.ksub
-        else:
-            _cm, _ks = "u8", index.ksub
+        _cm, _ks = _fused_code_layout(index)
         fused_ok = decode_feasible(
             m=index.codes.shape[1], code_mode=_cm, ksub=_ks,
             bpr=index.codes.shape[2],
@@ -1154,19 +1164,13 @@ def search(
             "VMEM-feasible list length (long lists with wide codebooks "
             "must use mode='scan' or more n_lists)",
         )
-        if index.additive:
-            books, code_mode, ksub = nibble_books(index.pq_centers), "nib8", 16
-        elif index.packed and index.pq_bits == 4:
-            # packed codes: byte b = (code 2b, code 2b+1); W's natural
-            # [nq, pq_dim, 16] flattening is exactly the kernel's per-byte
-            # [lo-hot | hi-hot] column order, so books pass through as-is
-            books, code_mode, ksub = index.pq_centers, "p4", 16
-        elif index.packed:
-            # 3/5/6/7-bit spanning bitstream: kernel peels each code from
-            # its (low, high) byte pair; W keeps the natural j-major order
-            books, code_mode, ksub = index.pq_centers, f"b{index.pq_bits}", index.ksub
-        else:
-            books, code_mode, ksub = index.pq_centers, "u8", index.ksub
+        code_mode, ksub = _fused_code_layout(index)
+        # nib8: additive nibble books, W columns = [A-hot | B-hot] per
+        # byte; p4: W's natural [nq, pq_dim, 16] flattening is exactly
+        # the kernel's per-byte [lo-hot | hi-hot] order; b3/5/6/7:
+        # spanning bitstream peeled from (low, high) byte pairs, W in
+        # natural j-major order
+        books = nibble_books(index.pq_centers) if index.additive else index.pq_centers
         rank = index.center_rank
         group = params.fused_group
         if rank is None:

@@ -4,11 +4,16 @@
 Given approximate candidate lists (e.g. from IVF-PQ or CAGRA), recompute
 exact distances between each query and its candidates and keep the best k.
 On TPU this is a batched gather + one small einsum per query block — XLA
-turns the [n_queries, n_candidates, dim] contraction into MXU work.
+turns the [n_queries, n_candidates, dim] contraction into MXU work. The
+whole body runs under one ``jit`` so the gather feeds the distance matmul
+and the top-k inside a single device program (eager dispatch per op costs
+several HBM round-trips plus, on tunneled dev chips, ~100 ms of host link
+per hop — measured 3-4x end-to-end on the bench's refine rows).
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import functools
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -17,6 +22,49 @@ from raft_tpu.core.errors import expects
 from raft_tpu.neighbors.brute_force import _tile_distances, _NORM_METRICS
 from raft_tpu.ops.distance import DistanceType, is_min_close, resolve_metric, row_norms
 from raft_tpu.ops.select_k import select_k, worst_value
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "metric_arg"))
+def _refine_impl(
+    dataset, queries, candidates, *, k: int, metric: DistanceType, metric_arg: float
+) -> Tuple[jax.Array, jax.Array]:
+    valid = candidates >= 0
+    safe_ids = jnp.where(valid, candidates, 0)
+    cand_vecs = dataset[safe_ids]  # [nq, n_cand, d]
+
+    qf = queries.astype(jnp.float32)
+    cf = cand_vecs.astype(jnp.float32)
+
+    select_min = is_min_close(metric)
+    worst = jnp.float32(worst_value(jnp.float32, select_min))
+
+    # Per-query exact distance to each candidate, via the same per-metric
+    # bodies as brute force (vmapped over the query axis).
+    q_sqnorm = row_norms(qf) if metric in _NORM_METRICS else None
+
+    def one_query(q, cands, qn):
+        qn_arr = None if qn is None else qn[None]
+        d = _tile_distances(
+            q[None, :],
+            qn_arr,
+            cands,
+            None if qn is None else row_norms(cands),
+            metric,
+            metric_arg,
+        )
+        return d[0]
+
+    if q_sqnorm is None:
+        dists = jax.vmap(lambda q, c: one_query(q, c, None))(qf, cf)
+    else:
+        dists = jax.vmap(lambda q, c, n: one_query(q, c, n))(qf, cf, q_sqnorm)
+
+    dists = jnp.where(valid, dists.astype(jnp.float32), worst)
+    vals, pos = select_k(dists, k, select_min=select_min)
+    idx = jnp.take_along_axis(candidates, pos, axis=1)
+    # Restore -1 for slots that selected an invalid (padded) candidate.
+    idx = jnp.where(jnp.take_along_axis(valid, pos, axis=1), idx, -1)
+    return vals, idx
 
 
 def refine(
@@ -63,38 +111,9 @@ def refine(
                 )
             else:
                 q, c = queries[s : s + cnt], candidates[s : s + cnt]
-            v, i = refine(dataset, q, c, k, metric, metric_arg, query_batch)
+            v, i = _refine_impl(dataset, q, c, k=k, metric=metric, metric_arg=metric_arg)
             out_v.append(v[:cnt])
             out_i.append(i[:cnt])
         return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
 
-    valid = candidates >= 0
-    safe_ids = jnp.where(valid, candidates, 0)
-    cand_vecs = dataset[safe_ids]  # [nq, n_cand, d]
-
-    qf = queries.astype(jnp.float32)
-    cf = cand_vecs.astype(jnp.float32)
-
-    select_min = is_min_close(metric)
-    worst = jnp.float32(worst_value(jnp.float32, select_min))
-
-    # Per-query exact distance to each candidate, via the same per-metric
-    # bodies as brute force (vmapped over the query axis).
-    q_sqnorm = row_norms(qf) if metric in _NORM_METRICS else None
-
-    def one_query(q, cands, qn):
-        qn_arr = None if qn is None else qn[None]
-        d = _tile_distances(q[None, :], qn_arr, cands, None if qn is None else row_norms(cands), metric, metric_arg)
-        return d[0]
-
-    if q_sqnorm is None:
-        dists = jax.vmap(lambda q, c: one_query(q, c, None))(qf, cf)
-    else:
-        dists = jax.vmap(lambda q, c, n: one_query(q, c, n))(qf, cf, q_sqnorm)
-
-    dists = jnp.where(valid, dists.astype(jnp.float32), worst)
-    vals, pos = select_k(dists, k, select_min=select_min)
-    idx = jnp.take_along_axis(candidates, pos, axis=1)
-    # Restore -1 for slots that selected an invalid (padded) candidate.
-    idx = jnp.where(jnp.take_along_axis(valid, pos, axis=1), idx, -1)
-    return vals, idx
+    return _refine_impl(dataset, queries, candidates, k=k, metric=metric, metric_arg=metric_arg)

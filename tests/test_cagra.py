@@ -83,6 +83,7 @@ class TestOptimize:
 
 
 class TestCagraSearch:
+    @pytest.mark.slow
     def test_recall_nn_descent_build(self, rng):
         n, d, nq, k = 2500, 32, 64, 10
         X = _data(rng, n, d)
@@ -113,6 +114,7 @@ class TestCagraSearch:
         recall = float(neighborhood_recall(np.asarray(ann), np.asarray(ref)))
         assert recall >= 0.85, f"recall {recall}"
 
+    @pytest.mark.slow
     def test_inner_product(self, rng):
         n, d, nq, k = 2000, 32, 48, 10
         X = _data(rng, n, d)
@@ -225,14 +227,15 @@ class TestVpq:
         assert rec >= urec - 0.3, (rec, urec)
 
     def test_vpq_serialize_roundtrip(self, rng):
+        # the suite's ONLY VPQ serialize coverage — fast tier, tiny shapes
         import io as _io
 
-        n, d = 1000, 16
+        n, d = 500, 16
         X = _data(rng, n, d, n_centers=8)
         index = cagra.build(
-            X, cagra.CagraIndexParams(intermediate_graph_degree=16, graph_degree=8, nn_descent_niter=6, seed=0)
+            X, cagra.CagraIndexParams(intermediate_graph_degree=12, graph_degree=8, nn_descent_niter=4, seed=0)
         )
-        comp = cagra.compress(index, cagra.VpqParams(pq_dim=4, pq_bits=6, kmeans_n_iters=6, seed=1))
+        comp = cagra.compress(index, cagra.VpqParams(pq_dim=4, pq_bits=5, kmeans_n_iters=4, seed=1))
         buf = _io.BytesIO()
         cagra.save(comp, buf)
         buf.seek(0)

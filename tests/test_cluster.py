@@ -142,13 +142,15 @@ def test_balanced_fit_predict(blobs):
 class TestFindK:
     def test_recovers_planted_k(self, rng):
         # make_blobs with a planted k; find_k must recover it (the
-        # reference's kmeans_auto_find_k contract)
+        # reference's kmeans_auto_find_k contract). Shapes kept tiny:
+        # this is the suite's ONLY find_k coverage, so it must stay in
+        # the fast tier.
         from raft_tpu.cluster.kmeans import find_k
         from raft_tpu.random import make_blobs
 
-        k_true = 5
-        X, _, _ = make_blobs(3, 300, 8, n_clusters=k_true, cluster_std=0.05)
-        best_k, inertia, n_iter = find_k(np.asarray(X), kmax=8, kmin=2, max_iter=25)
+        k_true = 4
+        X, _, _ = make_blobs(3, 160, 8, n_clusters=k_true, cluster_std=0.05)
+        best_k, inertia, n_iter = find_k(np.asarray(X), kmax=6, kmin=2, max_iter=15)
         assert best_k == k_true, best_k
         assert float(inertia) >= 0
 

@@ -64,7 +64,10 @@ class TestIvfPqBuild:
 
 
 class TestIvfPqSearch:
-    @pytest.mark.parametrize("codebook_kind", [PER_SUBSPACE, PER_CLUSTER])
+    @pytest.mark.parametrize(
+        "codebook_kind",
+        [PER_SUBSPACE, pytest.param(PER_CLUSTER, marks=pytest.mark.slow)],
+    )
     def test_recall_l2(self, rng, codebook_kind):
         n, d, nq, k = 6000, 32, 64, 10
         X = _clustered(rng, n, d)
@@ -148,6 +151,7 @@ class TestIvfPqSearch:
         idx = np.asarray(idx)
         assert ((idx % 2 == 1) | (idx < 0)).all()
 
+    @pytest.mark.slow
     def test_nearly_exact_when_uncompressed(self, rng):
         # pq_dim == dim with 8-bit codebooks on a small set: ADC error tiny.
         n, d, nq, k = 1500, 16, 24, 5

@@ -270,6 +270,7 @@ def test_extend_packed():
     assert int(np.asarray(i).max()) >= 2000  # extended rows are findable
 
 
+@pytest.mark.slow
 def test_multi_hot_decode_every_width():
     """Fast kernel-math coverage for ALL code layouts (u8, p4, nib8,
     b3/b5/b6/b7): _multi_hot's decode must reproduce the one-hot of the
