@@ -578,7 +578,10 @@ def _bench_main():
         )
         float(jnp.sum(cidx.graph[0].astype(jnp.float32)))
         build_times["cagra"] = round(time.perf_counter() - t0, 1)
-        for itopk, w, dd in ((96, 4, "post"), (128, 4, "post"), (160, 4, "post")):
+        # width 8: measured dominant over width 4 at equal itopk/recall
+        # (artifacts/tpu/cagra_width_sweep_*) — iterations drop ~2x while
+        # per-iteration fixed costs stay flat
+        for itopk, w, dd in ((96, 8, "post"), (128, 8, "post"), (160, 8, "post")):
             dt, (v, i) = _timed(
                 lambda itopk=itopk, w=w, dd=dd: cagra.search(
                     cidx, queries, K,
@@ -587,6 +590,17 @@ def _bench_main():
                 nrep=2,
             )
             record("cagra", f"itopk={itopk} w={w} dedup={dd}", dt, i)
+        # bf16 dataset: half the index memory at unchanged recall
+        cidx16 = dataclasses.replace(cidx, dataset=cidx.dataset.astype(jnp.bfloat16))
+        dt, (v, i) = _timed(
+            lambda: cagra.search(
+                cidx16, queries, K,
+                cagra.CagraSearchParams(itopk_size=128, search_width=8, dedup="post"),
+            ),
+            nrep=2,
+        )
+        record("cagra", "itopk=128 w=8 bf16-dataset", dt, i)
+        del cidx16
         # small-batch latency rows (the reference's single-CTA / multi-CTA
         # operating modes, search_plan.cuh:81-164): ms per batch, not QPS.
         if not over_budget(0.9):

@@ -249,16 +249,15 @@ class TestVpq:
 
 
 def test_plan_search_params_by_batch_shape():
-    """search_plan.cuh:81-164 analog: tiny batches get a wide low-latency
-    plan (fewer sequential iterations), big batches keep the batched
-    schedule, explicit overrides are respected."""
+    """search_plan.cuh:81-164 analog: every default-width plan takes the
+    measured-dominant wide beam (fewer sequential iterations), tiny
+    batches additionally seed from a larger sample, explicit overrides
+    are respected."""
     p1 = cagra.plan_search_params(1, 10, 1_000_000)
     pbig = cagra.plan_search_params(1024, 10, 1_000_000)
     assert p1.search_width >= 8
-    assert pbig.search_width == CagraSearchParams().search_width
-    _, _, it1, _ = cagra.derive_search_config(p1, 10, 1_000_000)
-    _, _, itb, _ = cagra.derive_search_config(pbig, 10, 1_000_000)
-    assert it1 < itb
+    assert pbig.search_width >= 8
+    assert p1.init_sample > pbig.init_sample  # latency regime seeds wider
     pexp = cagra.plan_search_params(
         1, 10, 100, CagraSearchParams(search_width=16, init_sample=64)
     )

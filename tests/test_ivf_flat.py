@@ -77,7 +77,7 @@ def test_distances_are_exact_for_found(data, index):
                 np.testing.assert_allclose(dist[q, j], true, rtol=1e-3, atol=1e-2)
 
 
-@pytest.mark.slow  # the IP metric path is also covered by the fused + PQ IP tests
+# fast tier: the only coverage of ivf_flat's max-similarity scan branch
 def test_inner_product(data):
     dataset, queries = data
     idx_ip = ivf_flat.build(dataset, n_lists=64, metric=DistanceType.InnerProduct, seed=0)
