@@ -18,6 +18,20 @@ def _data(rng, n, d, n_centers=16, scale=0.25):
     return (centers[labels] + scale * rng.standard_normal((n, d))).astype(np.float32)
 
 
+
+@pytest.fixture(scope="module")
+def nn_index():
+    """One shared nn-descent index (n=2000, d=16) for the filter /
+    serialize / VPQ tests — building it is the dominant cost of each of
+    those tests and none of them mutates it."""
+    rng = np.random.default_rng(33)
+    X = _data(rng, 2000, 16)
+    index = cagra.build(
+        X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=3)
+    )
+    return X, index
+
+
 class TestOptimize:
     def test_degree_and_validity(self, rng):
         n, kin, kout = 500, 16, 8
@@ -136,15 +150,12 @@ class TestCagraSearch:
         recall = float(neighborhood_recall(np.asarray(ann), np.asarray(ref)))
         assert recall >= 0.8, f"IP recall {recall}"
 
-    def test_prefilter(self, rng):
+    def test_prefilter(self, rng, nn_index):
         from raft_tpu.core.bitset import Bitset
 
-        n, d, nq, k = 2000, 16, 16, 5
-        X = _data(rng, n, d)
-        Q = _data(rng, nq, d)
-        index = cagra.build(
-            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=3)
-        )
+        X, index = nn_index
+        n, k = len(X), 5
+        Q = _data(rng, 16, 16)
         banned = np.arange(0, n, 2, dtype=np.int32)
         bs = Bitset.create(n, default=True).unset(banned)
         _, idx = cagra.search(
@@ -153,18 +164,15 @@ class TestCagraSearch:
         idx = np.asarray(idx)
         assert ((idx % 2 == 1) | (idx < 0)).all()
 
-    def test_selective_prefilter_still_returns_k(self, rng):
+    def test_selective_prefilter_still_returns_k(self, rng, nn_index):
         # 95% of ids banned: insertion-time filtering must keep valid
         # candidates competing for buffer slots (post-hoc filtering would
         # return mostly -1 here)
         from raft_tpu.core.bitset import Bitset
 
-        n, d, nq, k = 2000, 16, 16, 5
-        X = _data(rng, n, d)
-        Q = _data(rng, nq, d)
-        index = cagra.build(
-            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=5)
-        )
+        X, index = nn_index
+        n, k = len(X), 5
+        Q = _data(rng, 16, 16)
         allowed = np.arange(0, n, 20, dtype=np.int32)  # 5% allowed
         bs = Bitset.create(n, default=False).set(allowed)
         _, idx = cagra.search(
@@ -175,13 +183,10 @@ class TestCagraSearch:
         # most slots should actually be filled with allowed ids
         assert (idx >= 0).mean() >= 0.8
 
-    def test_from_graph_and_serialize(self, rng):
-        n, d, nq, k = 1500, 16, 16, 5
-        X = _data(rng, n, d)
-        Q = _data(rng, nq, d)
-        index = cagra.build(
-            X, CagraIndexParams(intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8, seed=4)
-        )
+    def test_from_graph_and_serialize(self, rng, nn_index):
+        k = 5
+        X, index = nn_index
+        Q = _data(rng, 16, 16)
         # round trip with dataset
         buf = io.BytesIO()
         cagra.save(index, buf)
@@ -226,15 +231,12 @@ class TestVpq:
         urec = float(neighborhood_recall(np.asarray(ui), np.asarray(ref)))
         assert rec >= urec - 0.3, (rec, urec)
 
-    def test_vpq_serialize_roundtrip(self, rng):
-        # the suite's ONLY VPQ serialize coverage — fast tier, tiny shapes
+    def test_vpq_serialize_roundtrip(self, rng, nn_index):
+        # the suite's ONLY VPQ serialize coverage — fast tier, reuses the
+        # shared module index (d=16, pq_dim=4 divides it)
         import io as _io
 
-        n, d = 500, 16
-        X = _data(rng, n, d, n_centers=8)
-        index = cagra.build(
-            X, cagra.CagraIndexParams(intermediate_graph_degree=12, graph_degree=8, nn_descent_niter=4, seed=0)
-        )
+        X, index = nn_index
         comp = cagra.compress(index, cagra.VpqParams(pq_dim=4, pq_bits=5, kmeans_n_iters=4, seed=1))
         buf = _io.BytesIO()
         cagra.save(comp, buf)
@@ -242,7 +244,7 @@ class TestVpq:
         loaded = cagra.load(buf)
         assert loaded.vpq is not None and loaded.dataset is None
         np.testing.assert_array_equal(np.asarray(loaded.vpq.codes), np.asarray(comp.vpq.codes))
-        Q = _data(rng, 16, d, n_centers=8)
+        Q = _data(rng, 16, 16, n_centers=8)
         v1, i1 = cagra.search(comp, Q, 5)
         v2, i2 = cagra.search(loaded, Q, 5)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

@@ -29,6 +29,18 @@ def _exact(dataset, queries, k, metric=DistanceType.L2Expanded):
     return brute_force.search(idx, queries, k)
 
 
+
+@pytest.fixture(scope="module")
+def pq8_index():
+    """Shared (X, index) built at n_lists=8 / pq_dim=8 for the filter /
+    extend / serialize tests — the build dominates each of them and
+    extend/save return new objects, leaving this one untouched."""
+    rng = np.random.default_rng(55)
+    X = _clustered(rng, 2000, 16)
+    index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, seed=7))
+    return X, index
+
+
 class TestIvfPqBuild:
     def test_shapes_and_packing(self, rng):
         n, d = 2000, 32
@@ -137,13 +149,12 @@ class TestIvfPqSearch:
         # ranking regression, not LUT-rounding noise
         assert recall >= 0.72, f"bf16-LUT recall {recall}"
 
-    def test_prefilter(self, rng):
+    def test_prefilter(self, rng, pq8_index):
         from raft_tpu.core.bitset import Bitset
 
-        n, d, nq, k = 2000, 16, 16, 5
-        X = _clustered(rng, n, d)
-        Q = _clustered(rng, nq, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, seed=7))
+        X, index = pq8_index
+        n, k = len(X), 5
+        Q = _clustered(rng, 16, 16)
         banned = np.arange(0, n, 2, dtype=np.int32)  # ban all even ids
         bs = Bitset.create(n, default=True).unset(banned)
         _, idx = ivf_pq.search(index, Q, k, n_probes=8, prefilter=bs)
@@ -164,11 +175,10 @@ class TestIvfPqSearch:
 
 
 class TestIvfPqExtendSerialize:
-    def test_extend(self, rng):
-        n, d = 2000, 16
-        X = _clustered(rng, n, d)
+    def test_extend(self, rng, pq8_index):
+        X, index = pq8_index
+        n, d = X.shape
         X2 = _clustered(rng, 500, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, seed=9))
         bigger = ivf_pq.extend(index, X2)
         assert bigger.size == n + 500
         ids = np.asarray(bigger.list_indices)
@@ -179,11 +189,10 @@ class TestIvfPqExtendSerialize:
         hits = (np.asarray(idx) >= n).any(axis=1)
         assert hits.mean() >= 0.75
 
-    def test_serialize_roundtrip(self, rng):
-        n, d, nq, k = 1500, 16, 8, 5
-        X = _clustered(rng, n, d)
-        Q = _clustered(rng, nq, d)
-        index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=8, pq_dim=8, seed=10))
+    def test_serialize_roundtrip(self, rng, pq8_index):
+        k = 5
+        X, index = pq8_index
+        Q = _clustered(rng, 8, 16)
         buf = io.BytesIO()
         ivf_pq.save(index, buf)
         buf.seek(0)

@@ -29,6 +29,17 @@ def _exact_graph(X, k, metric=DistanceType.L2Expanded):
     return out
 
 
+
+@pytest.fixture(scope="module")
+def nnd_small():
+    """Shared (X, build output) for the structural checks — the build is
+    each test's dominant cost and none of them mutates the result."""
+    rng = np.random.default_rng(77)
+    X = _data(rng, 1000, 16)
+    out = nn_descent.build(X, NNDescentParams(graph_degree=8, max_iterations=8, seed=1))
+    return X, out
+
+
 class TestNNDescent:
     def test_graph_recall_l2(self, rng):
         n, d, k = 2000, 32, 16
@@ -44,22 +55,20 @@ class TestNNDescent:
         recall = float(neighborhood_recall(np.asarray(out.graph), ref))
         assert recall >= 0.85, f"graph recall {recall}"
 
-    def test_no_self_loops_no_dups(self, rng):
-        n, d, k = 1000, 16, 8
-        X = _data(rng, n, d)
-        out = nn_descent.build(X, NNDescentParams(graph_degree=k, max_iterations=8, seed=1))
+    def test_no_self_loops_no_dups(self, nnd_small):
+        _, out = nnd_small
         g = np.asarray(out.graph)
+        n = g.shape[0]
         rows = np.arange(n)[:, None]
         assert (g != rows).all(), "self-loop in graph"
         for i in range(0, n, 97):
             row = g[i][g[i] >= 0]
             assert len(set(row.tolist())) == len(row), f"dup in row {i}"
 
-    def test_distances_sorted_and_correct(self, rng):
-        n, d, k = 800, 16, 8
-        X = _data(rng, n, d)
-        out = nn_descent.build(X, NNDescentParams(graph_degree=k, max_iterations=8, seed=2))
+    def test_distances_sorted_and_correct(self, nnd_small):
+        X, out = nnd_small
         g = np.asarray(out.graph)
+        n, k = g.shape
         dv = np.asarray(out.distances)
         assert (np.diff(dv, axis=1) >= -1e-4).all(), "distances not sorted"
         # spot-check distance values
