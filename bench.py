@@ -477,6 +477,7 @@ def _bench_main():
         bf16_idx = dataclasses.replace(fidx, list_data=fidx.list_data.astype(jnp.bfloat16))
         flat_kw = dict(fused_qt=128, fused_probe_factor=32, fused_merge="bank8",
                        fused_precision="default", fused_col_chunk=1024)
+        flat_tag = f"pf={flat_kw['fused_probe_factor']} {flat_kw['fused_merge']}"
         for npr, g in ((30, 8), (20, 8), (30, 16)):
             sp = ivf_flat.IvfFlatSearchParams(n_probes=npr, fused_group=g, **flat_kw)
             dt, (v, i) = _timed(
@@ -484,7 +485,7 @@ def _bench_main():
             )
             # streamed bytes estimate: npr mean-sized lists of bf16 rows per query
             gbps = npr / n_lists_flat * n_rows * dim * 2 * nq / dt / 1e9
-            record("ivf_flat", f"fused bf16 npr={npr} pf=32 G={g} bank8", dt, i,
+            record("ivf_flat", f"fused bf16 npr={npr} {flat_tag} G={g}", dt, i,
                    stream_gbps_est=round(gbps, 1))
         del bf16_idx
 
@@ -495,12 +496,16 @@ def _bench_main():
         # measured +~40% QPS at ~0.967 recall (artifacts/tpu/
         # ivf_flat_int8_vs_bf16_*).
         s8 = float(127.0 / jnp.max(jnp.abs(fidx.list_data)))
+        from raft_tpu.ops.distance import row_norms
+
         ld8 = jnp.clip(jnp.round(fidx.list_data * s8), -127, 127).astype(jnp.int8)
         idx8 = dataclasses.replace(
             fidx,
             centers=fidx.centers * s8,
             list_data=ld8,
-            list_norms=jnp.sum(ld8.astype(jnp.float32) ** 2, axis=-1),
+            list_norms=row_norms(ld8.reshape(-1, dim).astype(jnp.float32)).reshape(
+                ld8.shape[:2]
+            ),
         )
         q8 = queries * s8
         for npr in (30, 40):

@@ -59,3 +59,31 @@ def test_pareto_frontier_shape():
 def test_plot_writes_png(tmp_path):
     out = plot_report(_report(), str(tmp_path / "plot.png"), title="unit")
     assert os.path.exists(out) and os.path.getsize(out) > 1000
+
+
+def test_artifact_recorder_incremental(tmp_path):
+    """tools/_artifact.Recorder: every add() leaves a complete, parseable
+    JSON on disk (atomic replace), so a run killed between rows cannot
+    corrupt or lose earlier measurements — the property the round-4
+    verdict asked the TPU evidence chain to have."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_artifact_under_test", os.path.join(root, "tools", "_artifact.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    rec = mod.Recorder("unit", {"device": "test"}, out_dir=str(tmp_path))
+    assert os.path.exists(rec.path)
+    for i in range(3):
+        rec.add({"row": i})
+        with open(rec.path) as f:
+            doc = json.load(f)
+        assert [r["row"] for r in doc["rows"]] == list(range(i + 1))
+    rec.set_context(extra=1)
+    with open(rec.path) as f:
+        doc = json.load(f)
+    assert doc["context"]["extra"] == 1 and doc["context"]["device"] == "test"
+    assert not os.path.exists(rec.path + ".tmp")

@@ -12,9 +12,10 @@ import time
 
 
 class Recorder:
-    def __init__(self, name: str, context=None):
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        out_dir = os.path.join(root, "artifacts", "tpu")
+    def __init__(self, name: str, context=None, out_dir=None):
+        if out_dir is None:
+            root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            out_dir = os.path.join(root, "artifacts", "tpu")
         os.makedirs(out_dir, exist_ok=True)
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
         self.path = os.path.join(out_dir, f"{name}_{stamp}.json")
