@@ -179,3 +179,41 @@ def test_ivf_flat_integer_dtypes(rng, dtype):
         _, i = ivf_flat.search(index, Q, k, n_probes=16, mode=mode)
         rec = float(neighborhood_recall(np.asarray(i), np.asarray(ref)))
         assert rec >= 0.95, (mode, rec)
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.int8])
+def test_native_integer_datasets(rng, dtype):
+    """int8/uint8 datasets build and search natively — list storage keeps
+    the dataset dtype (1 B/element, half of bf16's DMA) and both the scan
+    and fused paths cast per block in-kernel. Reference parity: the
+    float/half/int8/uint8 dtype set of ``ivf_flat_types.hpp:44`` /
+    ``ivf_flat_interleaved_scan-inl.cuh:106-650``."""
+    centers = rng.integers(30, 220, (16, 32))
+    lo, hi = (0, 255) if dtype == np.uint8 else (-128, 127)
+    off = 0 if dtype == np.uint8 else -128
+    X = np.clip(centers[rng.integers(0, 16, 3000)] + rng.normal(0, 12, (3000, 32)) + off, lo, hi).astype(dtype)
+    Q = np.clip(centers[rng.integers(0, 16, 48)] + rng.normal(0, 12, (48, 32)) + off, lo, hi).astype(dtype)
+
+    bf = brute_force.build(X.astype(np.float32))
+    _, gt = brute_force.search(bf, Q.astype(np.float32), 10)
+
+    index = ivf_flat.build(jnp.asarray(X), IvfFlatIndexParams(n_lists=16, kmeans_n_iters=5, seed=0))
+    assert index.list_data.dtype == dtype
+    for mode in ("scan", "fused"):
+        _, i = ivf_flat.search(
+            index, jnp.asarray(Q), 10,
+            IvfFlatSearchParams(n_probes=8, fused_qt=16, fused_probe_factor=16, fused_group=4),
+            mode=mode,
+        )
+        rec = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
+        assert rec >= 0.95, (dtype, mode, rec)
+
+    # serialization keeps the integer storage
+    buf = io.BytesIO()
+    ivf_flat.save(index, buf)
+    buf.seek(0)
+    loaded = ivf_flat.load(buf)
+    assert loaded.list_data.dtype == dtype
+    _, i1 = ivf_flat.search(index, jnp.asarray(Q), 5, n_probes=8)
+    _, i2 = ivf_flat.search(loaded, jnp.asarray(Q), 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
