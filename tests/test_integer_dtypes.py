@@ -1,0 +1,58 @@
+"""Native int8/uint8 dataset support across the index families — the
+reference's dtype set (``ivf_flat_types.hpp:44``, ``ivf_pq`` /
+``cagra`` / ``brute_force`` int8/uint8 instantiations under
+``cpp/src/neighbors/``). Storage keeps the integer dtype (1 B/element);
+kernels cast per block. IVF-Flat's variant lives in
+``test_ivf_flat.py::test_native_integer_datasets`` with serialization.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.neighbors import brute_force, cagra, ivf_pq
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def u8_data():
+    rng = np.random.default_rng(11)
+    centers = rng.integers(30, 220, (16, 32))
+    X = np.clip(
+        centers[rng.integers(0, 16, 2500)] + rng.normal(0, 12, (2500, 32)), 0, 255
+    ).astype(np.uint8)
+    Q = np.clip(
+        centers[rng.integers(0, 16, 32)] + rng.normal(0, 12, (32, 32)), 0, 255
+    ).astype(np.uint8)
+    gt_index = brute_force.build(X.astype(np.float32))
+    _, gt = brute_force.search(gt_index, Q.astype(np.float32), 10)
+    return X, Q, np.asarray(gt)
+
+
+def test_brute_force_uint8(u8_data):
+    X, Q, gt = u8_data
+    index = brute_force.build(jnp.asarray(X))
+    assert index.dataset.dtype == jnp.uint8  # stored as-is, not upcast
+    _, i = brute_force.search(index, jnp.asarray(Q), 10)
+    assert float(neighborhood_recall(np.asarray(i), gt)) == 1.0
+
+
+def test_ivf_pq_uint8(u8_data):
+    X, Q, gt = u8_data
+    index = ivf_pq.build(
+        jnp.asarray(X), ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5, seed=1)
+    )
+    _, i = ivf_pq.search(index, jnp.asarray(Q), 10, ivf_pq.IvfPqSearchParams(n_probes=8))
+    # ADC on integer data: same recall class as the float tests' floor
+    assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.7
+
+
+def test_cagra_uint8(u8_data):
+    X, Q, gt = u8_data
+    index = cagra.build(
+        jnp.asarray(X),
+        cagra.CagraIndexParams(intermediate_graph_degree=16, graph_degree=8, nn_descent_niter=4, seed=0),
+    )
+    assert index.dataset.dtype == jnp.uint8
+    _, i = cagra.search(index, jnp.asarray(Q), 10, cagra.CagraSearchParams(itopk_size=32))
+    assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.95
