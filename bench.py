@@ -618,6 +618,7 @@ def _bench_main():
         cagra_err = "skipped: time budget exhausted before CAGRA build"
     elif pidx is None:
         cagra_err = "skipped: no PQ index for the graph build (ivf_pq phase failed or was skipped)"
+    if cagra_err:
         print(f"# {cagra_err}", flush=True)
     try:
         if cagra_err:

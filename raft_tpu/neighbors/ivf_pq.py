@@ -1139,6 +1139,8 @@ def search(
         fused_ok = decode_feasible(
             m=index.codes.shape[1], code_mode=_cm, ksub=_ks,
             bpr=index.codes.shape[2],
+            qt=params.fused_qt, k=k, rot_dim=index.rotation.shape[0],
+            merge=params.fused_merge,
         )
     # the fused kernel's LUT is bf16 by construction; an explicit float32
     # request is a precision demand auto must honor via the scan path
@@ -1208,13 +1210,21 @@ def search(
                 ksub=ksub,
                 extract_every=params.fused_extract_every,
                 # VMEM-model cap: wide-codebook decode chunks must fit
-                # the ~16 MB scoped-VMEM stack at any list length
+                # the ~16 MB scoped-VMEM stack at any list length. The
+                # budget is derived from the kernel's fixed residents at
+                # THIS shape (vmem_model.pq_decode_chunk_budget), so the
+                # exact qt/k/group/merge config sharpens the cap.
                 decode_cols=vmem_decode_cols(
                     params.fused_decode_cols,
                     m=index.codes.shape[1],
                     code_mode=code_mode,
                     ksub=ksub,
                     bpr=index.codes.shape[2],
+                    qt=params.fused_qt,
+                    k=k,
+                    g_lists=group,
+                    rot_dim=index.rotation.shape[0],
+                    merge=params.fused_merge,
                 ),
                 interpret=jax.default_backend() != "tpu",
             )

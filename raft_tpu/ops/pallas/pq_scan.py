@@ -52,6 +52,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.core.errors import expects
 from raft_tpu.ops.distance import DistanceType
+from raft_tpu.ops.pallas import vmem_model
 from raft_tpu.ops.pallas.ivf_scan import (
     _eff_banks,
     _extract_topk,
@@ -72,14 +73,10 @@ def supported_metric(metric: DistanceType) -> bool:
     return metric in _SUPPORTED
 
 
-def _code_groups(code_mode: str, ksub: int, bpr: int) -> Tuple[int, int]:
-    """(n_groups, gw): the multi-hot column space is ``n_groups`` groups
-    of ``gw`` columns — one group per stored byte for u8/nib8/p4, one per
-    CODE for the spanning b3/b5/b6/b7 layouts."""
-    if code_mode in ("b3", "b5", "b6", "b7"):
-        b = int(code_mode[1:])
-        return bpr * 8 // b, ksub
-    return bpr, (ksub if code_mode == "u8" else 32)
+# (n_groups, gw) of the multi-hot column space — shared with the VMEM
+# residency model so the decode-chunk budget and the kernel agree on the
+# column layout by construction.
+_code_groups = vmem_model.code_groups
 
 
 def _multi_hot(cod, *, code_mode: str, ksub: int, m: int, bpr: int,
@@ -153,52 +150,70 @@ def _multi_hot(cod, *, code_mode: str, ksub: int, m: int, bpr: int,
     return (val == sub16).astype(jnp.bfloat16)
 
 
-_DECODE_CHUNK_BUDGET = 8_000_000  # bytes of scoped VMEM for one decode chunk
+# per-cell decode footprint — shared with the VMEM residency model
+_decode_cell_bytes = vmem_model.decode_cell_bytes
 
 
-def _decode_cell_bytes(code_mode: str) -> int:
-    """Peak live bytes per (row, column) of a decode chunk. u8/nib8/p4
-    hold the f32 byte-spread + the bf16 multi-hot (~6 B); the spanning
-    bit layouts keep TWO f32 byte-spreads (low/high byte) plus f32 peel
-    temps live at once (~14 B)."""
-    return 14 if code_mode.startswith("b") and code_mode[1:].isdigit() else 6
+def _decode_chunk_budget(*, m: int, code_mode: str, ksub: int, bpr: int,
+                         **model_kwargs) -> int:
+    """Bytes of scoped VMEM one decode chunk may use at this shape:
+    ``VMEM_HEADROOM x VMEM_LIMIT`` minus the kernel's fixed residents
+    (W tile, q_rot, bank/acc scratch, double-buffered code+epilogue
+    DMA, dot accumulator) as accounted by
+    :func:`raft_tpu.ops.pallas.vmem_model.pq_decode_chunk_budget`.
+    Replaces the historical hand-calibrated 8 MB constant, which this
+    derivation reproduces within 2% at its calibration shape
+    (m=1152, ksub=256) while adapting to every other shape."""
+    return vmem_model.pq_decode_chunk_budget(
+        m=m, code_mode=code_mode, ksub=ksub, bpr=bpr, **model_kwargs
+    )
 
 
-def decode_feasible(*, m: int, code_mode: str, ksub: int, bpr: int) -> bool:
-    """Whether even a single-group decode chunk fits the VMEM budget —
-    false for very long lists with wide codebooks (e.g. ksub=256 with
-    max_list > ~5200), where the fused kernel cannot compile and callers
-    must use the scan path instead."""
+def decode_feasible(*, m: int, code_mode: str, ksub: int, bpr: int,
+                    **model_kwargs) -> bool:
+    """Whether even a single-group decode chunk fits the derived VMEM
+    budget — false for very long lists with wide codebooks (e.g.
+    ksub=256 with max_list > ~3400), where the fused kernel cannot
+    compile and callers must use the scan path instead."""
     _, gw = _code_groups(code_mode, ksub, bpr)
-    return _decode_cell_bytes(code_mode) * m * gw <= _DECODE_CHUNK_BUDGET
+    budget = _decode_chunk_budget(
+        m=m, code_mode=code_mode, ksub=ksub, bpr=bpr, **model_kwargs
+    )
+    return _decode_cell_bytes(code_mode) * m * gw <= budget
 
 
 def vmem_decode_cols(requested: int, *, m: int, code_mode: str, ksub: int,
-                     bpr: int) -> int:
+                     bpr: int, **model_kwargs) -> int:
     """Cap the decode column chunk so the kernel's scoped-VMEM stack fits
     the TPU's ~16 MB limit.
 
     A chunk materializes the multi-hot ``S [m, Kc]`` bf16 plus f32
     byte-spread intermediates (see :func:`_decode_cell_bytes`). Measured
     at the 1M-row bench shape (m=1152, ksub=256, Kc=2048) the kernel
-    needs 17.19 MB and the Mosaic compile dies at 16 MB; capping the
-    chunk to an ~8 MB budget leaves room for the fixed residents (W
-    tile, bank scratch, double-buffered code DMA, dot accumulators)
-    with margin. Chunks cover whole code groups, so the cap rounds down
-    to a multiple of the group width. Raises when even one group cannot
-    fit (use :func:`decode_feasible` to route such shapes to the scan
-    path up front)."""
+    needs 17.19 MiB and the Mosaic compile dies at 16 MiB; capping the
+    chunk to the per-shape budget :func:`_decode_chunk_budget` derives
+    from the kernel's fixed residents keeps the whole stack inside the
+    limit with margin. Chunks cover whole code groups, so the cap rounds
+    down to a multiple of the group width. Raises when even one group
+    cannot fit (use :func:`decode_feasible` to route such shapes to the
+    scan path up front). ``model_kwargs`` (``qt``/``k``/``g_lists``/
+    ``rot_dim``/``merge``) refine the resident accounting; omitted ones
+    fall back to conservative defaults."""
     n_groups, gw = _code_groups(code_mode, ksub, bpr)
     K = n_groups * gw
     if not requested:
         requested = K
     expects(
-        decode_feasible(m=m, code_mode=code_mode, ksub=ksub, bpr=bpr),
+        decode_feasible(m=m, code_mode=code_mode, ksub=ksub, bpr=bpr,
+                        **model_kwargs),
         "fused PQ decode infeasible: one %d-column group over %d rows "
         "exceeds the VMEM chunk budget — use mode='scan' or more lists",
         gw, m,
     )
-    cap = int(_DECODE_CHUNK_BUDGET // (_decode_cell_bytes(code_mode) * max(m, 1)))
+    budget = _decode_chunk_budget(
+        m=m, code_mode=code_mode, ksub=ksub, bpr=bpr, **model_kwargs
+    )
+    cap = int(budget // (_decode_cell_bytes(code_mode) * max(m, 1)))
     cap = max(gw, (cap // gw) * gw)
     return min(requested, cap, K)
 
@@ -287,6 +302,19 @@ def _make_pq_kernel(*, k, metric, merge, qt, m, g_lists, n_steps, K,
     return kernel
 
 
+def kernel_scratch_shapes(qt: int, k: int, banks: int):
+    """The fused PQ kernel's scratch declarations: running top-k
+    accumulator pair + bank-merge pair. Split out so tests can assert
+    the VMEM residency model against the shapes the kernel actually
+    allocates (``vmem_model.pq_scan_residency`` mirrors these)."""
+    return [
+        pltpu.VMEM((qt, k), jnp.float32),
+        pltpu.VMEM((qt, k), jnp.int32),
+        pltpu.VMEM((qt, banks * 128), jnp.float32),
+        pltpu.VMEM((qt, banks * 128), jnp.int32),
+    ]
+
+
 def pq_lut(q_rot, books) -> jax.Array:
     """Per-query LUT ``W [nq, K]`` bf16: ``W[n, (j, c)] = <q_sub[n, j],
     books[j, c]>`` (the ``compute_similarity`` smem LUT, built once per
@@ -352,19 +380,17 @@ def fused_pq_topk(
             pl.BlockSpec((qt, K), lambda i, j, pr, pv: (i, 0)),
             pl.BlockSpec((qt, rot_dim), lambda i, j, pr, pv: (i, 0)),
             pl.BlockSpec((1, g_lists, rot_dim), lambda i, j, pr, pv: (pr[i, j], 0, 0)),
-            pl.BlockSpec((1, gm, bpr), lambda i, j, pr, pv: (pr[i, j], 0, 0)),
+            # codes rows are deliberately narrow (bpr = 16-64 B/row is
+            # the whole point of PQ): the lane padding the linter sees
+            # costs VMEM but the HBM DMA moves only the real code bytes
+            pl.BlockSpec((1, gm, bpr), lambda i, j, pr, pv: (pr[i, j], 0, 0)),  # graft-lint: ignore[tile-align]
             pl.BlockSpec((1, 1, gm), lambda i, j, pr, pv: (pr[i, j], 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((qt, k), lambda i, j, pr, pv: (i, 0)),
             pl.BlockSpec((qt, k), lambda i, j, pr, pv: (i, 0)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((qt, k), jnp.float32),
-            pltpu.VMEM((qt, k), jnp.int32),
-            pltpu.VMEM((qt, banks * 128), jnp.float32),
-            pltpu.VMEM((qt, banks * 128), jnp.int32),
-        ],
+        scratch_shapes=kernel_scratch_shapes(qt, k, banks),
     )
     return pl.pallas_call(
         kernel,

@@ -1,0 +1,251 @@
+"""VMEM residency model for the fused Pallas scan kernels.
+
+The reference ships correctness tooling alongside its kernels
+(compile-time template checks, sanitizer CI); this module is the TPU
+analog for the resource axis: a byte-accurate model of what one grid
+step of a fused scan keeps live in VMEM, used three ways —
+
+* :mod:`raft_tpu.ops.pallas.pq_scan` derives its decode-chunk budget
+  from the model's fixed residents instead of a hand-calibrated
+  constant, so scratch-shape drift moves the cap instead of silently
+  reintroducing Mosaic compile failures;
+* tests assert the model against the kernel's actual scratch/BlockSpec
+  shapes and against the measured 17.19 MiB residency of the 1M-row
+  bench shape (m=1152, ksub=256) that motivated the cap;
+* ``tools/graft_lint`` cross-checks the shapes it parses out of the
+  kernel source against the same accounting.
+
+Accounting rules (see ``docs/static_analysis.md`` for the rationale):
+
+* every in/out tile contributes ``block_bytes x buffers`` where
+  ``buffers = 2`` when the tile's block index varies along the
+  *innermost* grid axis (the DMA pipeline double-buffers it) and 1 when
+  it only changes at outer-axis boundaries, where the pipeline drains
+  anyway;
+* scratch buffers contribute their full size once — they persist across
+  the whole grid;
+* kernel-body intermediates contribute their peak: for the PQ decode
+  that is one column chunk of the multi-hot ``S`` plus its f32
+  byte-spread temps (:func:`decode_cell_bytes`) and the ``[qt, m]``
+  f32 dot accumulator.
+
+Sub-(8, 128) tiles are modeled at logical size; Mosaic's lane/sublane
+padding of the k-sized accumulators is a second-order effect (<2% at
+every supported shape).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Optional, Tuple
+
+#: Per-core VMEM on current TPU generations (v4/v5): 16 MiB. Mosaic
+#: rejects kernels whose scoped allocation exceeds it.
+VMEM_LIMIT_BYTES = 16 * 1024 * 1024
+
+#: Fraction of VMEM the model lets a kernel plan for. The remainder
+#: absorbs what the model cannot see: Mosaic spill slots, semaphores,
+#: and compiler-scheduled copies. 0.75 reproduces (within 2%) the 8 MB
+#: decode budget that was hand-calibrated against the 1M-row bench
+#: shape before this model existed.
+VMEM_HEADROOM = 0.75
+
+
+def code_groups(code_mode: str, ksub: int, bpr: int) -> Tuple[int, int]:
+    """(n_groups, gw): the PQ multi-hot column space is ``n_groups``
+    groups of ``gw`` columns — one group per stored byte for u8/nib8/p4,
+    one per CODE for the spanning b3/b5/b6/b7 bit layouts."""
+    if code_mode in ("b3", "b5", "b6", "b7"):
+        b = int(code_mode[1:])
+        return bpr * 8 // b, ksub
+    return bpr, (ksub if code_mode == "u8" else 32)
+
+
+def decode_cell_bytes(code_mode: str) -> int:
+    """Peak live bytes per (row, column) of one PQ decode chunk. u8/
+    nib8/p4 hold the f32 byte-spread + the bf16 multi-hot (~6 B); the
+    spanning bit layouts keep TWO f32 byte-spreads (low/high byte) plus
+    f32 peel temps live at once (~14 B)."""
+    return 14 if code_mode.startswith("b") and code_mode[1:].isdigit() else 6
+
+
+def merge_banks(merge: str, m: int) -> int:
+    """Bank count of the running top-k scratch for a ``bank<N>`` merge
+    mode, clamped to the lane-group count of one compress call (mirrors
+    ``ivf_scan._eff_banks`` at col_chunk=0)."""
+    g = re.search(r"(\d+)$", merge)
+    n = int(g.group(1)) if g else 0
+    if merge.startswith("bank"):
+        n = n or 4
+    elif merge.startswith("seg"):
+        n = n or 2
+    return max(1, min(n, math.ceil(m / 128)))
+
+
+@dataclasses.dataclass(frozen=True)
+class Resident:
+    """One VMEM-resident buffer of a kernel grid step.
+
+    ``kind`` is ``"tile"`` (BlockSpec in/out block), ``"scratch"``
+    (``pltpu.VMEM`` scratch), ``"body"`` (peak kernel-body
+    intermediate), or ``"chunk"`` (the sizeable *scalable* body
+    intermediate the budget is solved for)."""
+
+    name: str
+    shape: Tuple[int, ...]
+    itemsize: int
+    buffers: int = 1
+    kind: str = "tile"
+
+    @property
+    def nbytes(self) -> int:
+        return int(math.prod(self.shape)) * self.itemsize * self.buffers
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelResidency:
+    """The model's full accounting for one kernel configuration."""
+
+    kernel: str
+    residents: Tuple[Resident, ...]
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(r.nbytes for r in self.residents)
+
+    @property
+    def fixed_bytes(self) -> int:
+        """Bytes that do not scale with the decode chunk size."""
+        return sum(r.nbytes for r in self.residents if r.kind != "chunk")
+
+    def by_name(self, name: str) -> Resident:
+        for r in self.residents:
+            if r.name == name:
+                return r
+        raise KeyError(name)
+
+    def table(self) -> str:
+        rows = [
+            "%-14s %-18s x%d %10d B  [%s]"
+            % (r.name, "x".join(map(str, r.shape)), r.buffers, r.nbytes, r.kind)
+            for r in self.residents
+        ]
+        rows.append("total: %d B (%.2f MiB)" % (self.total_bytes, self.total_bytes / 2**20))
+        return "\n".join(rows)
+
+
+def pq_scan_residency(
+    *,
+    m: int,
+    code_mode: str,
+    ksub: int,
+    bpr: int,
+    qt: int = 128,
+    k: int = 128,
+    g_lists: int = 8,
+    rot_dim: int = 128,
+    merge: str = "bank8",
+    decode_cols: int = 0,
+) -> KernelResidency:
+    """Model ``pq_scan.fused_pq_topk``'s VMEM residency for one grid
+    step. Mirrors the kernel's grid spec exactly — the shapes here are
+    asserted against the literal BlockSpec/scratch declarations in
+    tests (``test_pq_fused.py``), so the two cannot drift apart
+    silently.
+
+    ``decode_cols=0`` omits the decode chunk (useful for computing the
+    fixed residents the chunk budget is solved against); defaults for
+    ``qt``/``k``/``g_lists``/``merge`` match ``IvfPqSearchParams``
+    (``k=128`` is a conservative stand-in when the caller's k is
+    unknown — the k-sized residents are <3% of the stack)."""
+    n_groups, gw = code_groups(code_mode, ksub, bpr)
+    K = n_groups * gw
+    gm = g_lists * m
+    banks = merge_banks(merge, m)
+    residents = [
+        # in tiles, in fused_pq_topk's in_specs order. Index maps that
+        # reference the inner grid axis j (the probe step) are
+        # double-buffered by the DMA pipeline; w/q_rot/outs only move
+        # with the query-tile axis i.
+        Resident("w_tile", (qt, K), 2),                      # bf16 LUT rows
+        Resident("q_rot", (qt, rot_dim), 4),
+        Resident("centers_rot", (1, g_lists, rot_dim), 4, buffers=2),
+        Resident("codes", (1, gm, bpr), 1, buffers=2),
+        Resident("ln", (1, 1, gm), 4, buffers=2),
+        Resident("out_vals", (qt, k), 4),
+        Resident("out_idx", (qt, k), 4),
+        # scratch_shapes, in declaration order
+        Resident("acc_vals", (qt, k), 4, kind="scratch"),
+        Resident("acc_idx", (qt, k), 4, kind="scratch"),
+        Resident("bank_vals", (qt, banks * 128), 4, kind="scratch"),
+        Resident("bank_idx", (qt, banks * 128), 4, kind="scratch"),
+        # peak kernel-body intermediates
+        Resident("dot_acc", (qt, m), 4, kind="body"),
+    ]
+    if decode_cols:
+        residents.append(
+            Resident(
+                "decode_chunk", (m, decode_cols), decode_cell_bytes(code_mode),
+                kind="chunk",
+            )
+        )
+    return KernelResidency("pq_scan.fused_pq_topk", tuple(residents))
+
+
+def pq_decode_chunk_budget(
+    *,
+    m: int,
+    code_mode: str,
+    ksub: int,
+    bpr: int,
+    qt: int = 128,
+    k: int = 128,
+    g_lists: int = 8,
+    rot_dim: int = 128,
+    merge: str = "bank8",
+    limit: int = VMEM_LIMIT_BYTES,
+    headroom: float = VMEM_HEADROOM,
+) -> int:
+    """Bytes one PQ decode chunk may occupy: ``headroom x limit`` minus
+    the kernel's fixed residents at this shape. Replaces the historical
+    hand-calibrated 8 MB ``_DECODE_CHUNK_BUDGET`` — at the calibration
+    shape (m=1152, ksub=256, k<=128) this derives ~7.85 MB, and unlike
+    the constant it shrinks for longer lists / wider code rows whose
+    fixed residents (dot accumulator, code DMA buffers) grow. May be
+    <= 0: no chunk fits, the shape is fused-infeasible."""
+    fixed = pq_scan_residency(
+        m=m, code_mode=code_mode, ksub=ksub, bpr=bpr, qt=qt, k=k,
+        g_lists=g_lists, rot_dim=rot_dim, merge=merge, decode_cols=0,
+    ).fixed_bytes
+    return int(limit * headroom) - fixed
+
+
+def ivf_scan_residency(
+    *,
+    m: int,
+    d: int,
+    qt: int = 128,
+    k: int = 128,
+    merge: str = "bank8",
+    itemsize: int = 4,
+) -> KernelResidency:
+    """Model ``ivf_scan.fused_list_topk``'s residency (col_chunk=0):
+    one query tile, one double-buffered list block + prepared epilogue
+    and id rows, the top-k accumulator and bank scratch, and the
+    ``[qt, m]`` f32 score block."""
+    banks = merge_banks(merge, m)
+    residents = [
+        Resident("q_tile", (qt, d), 4),
+        Resident("list_data", (1, m, d), itemsize, buffers=2),
+        Resident("ln", (1, 1, m), 4, buffers=2),
+        Resident("list_idx", (1, 1, m), 4, buffers=2),
+        Resident("out_vals", (qt, k), 4),
+        Resident("out_idx", (qt, k), 4),
+        Resident("acc_vals", (qt, k), 4, kind="scratch"),
+        Resident("acc_idx", (qt, k), 4, kind="scratch"),
+        Resident("bank_vals", (qt, banks * 128), 4, kind="scratch"),
+        Resident("bank_idx", (qt, banks * 128), 4, kind="scratch"),
+        Resident("score", (qt, m), 4, kind="body"),
+    ]
+    return KernelResidency("ivf_scan.fused_list_topk", tuple(residents))

@@ -1,0 +1,10 @@
+"""Seeded violation: jnp.arange with float arguments and no dtype — the
+result dtype flips f32/f64 with the jax_enable_x64 flag.
+
+Expected: exactly one ``implicit-dtype`` on the marked line.
+"""
+import jax.numpy as jnp
+
+
+def ramp():
+    return jnp.arange(0.0, 1.0, 0.1)  # LINT-HERE

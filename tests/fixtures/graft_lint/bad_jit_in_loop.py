@@ -1,0 +1,13 @@
+"""Seeded violation: jax.jit constructed inside a loop — each iteration
+builds a fresh wrapper with an empty compilation cache.
+
+Expected: exactly one ``jit-in-loop`` on the marked line.
+"""
+import jax
+
+
+def compile_all(fns):
+    compiled = []
+    for fn in fns:
+        compiled.append(jax.jit(fn))  # LINT-HERE
+    return compiled

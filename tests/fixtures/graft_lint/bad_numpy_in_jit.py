@@ -1,0 +1,11 @@
+"""Seeded violation: np.* called on a traced value inside @jax.jit.
+
+Expected: exactly one ``numpy-in-jit`` on the marked line.
+"""
+import jax
+import numpy as np
+
+
+@jax.jit
+def prefix_sum(x):
+    return np.cumsum(x)  # LINT-HERE

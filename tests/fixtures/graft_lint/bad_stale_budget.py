@@ -1,0 +1,29 @@
+"""Seeded violation: a hard-coded ``*_BUDGET`` byte constant that
+disagrees with the budget derived from the module's own declarations
+(75% of 16 MiB minus 3 aligned 256x128 f32 residents = ~12.2 MB, not
+2 MB).
+
+Expected: exactly one ``stale-budget`` on the marked line.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_COPY_CHUNK_BUDGET = 2_000_000  # LINT-HERE
+
+
+def _copy_kernel(x_ref, o_ref, acc_ref):
+    acc_ref[...] = x_ref[...]
+    o_ref[...] = acc_ref[...]
+
+
+def staged_copy(x):
+    return pl.pallas_call(
+        _copy_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(8,),
+        in_specs=[pl.BlockSpec((256, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((256, 128), lambda i: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((256, 128), jnp.float32)],
+    )(x)

@@ -1,0 +1,11 @@
+"""Seeded violation: static_argnames naming a parameter that does not
+exist.
+
+Expected: exactly one ``static-args`` on the marked line.
+"""
+import jax
+
+
+@jax.jit(static_argnames=("mode",))  # LINT-HERE
+def scale(x, factor):
+    return x * factor

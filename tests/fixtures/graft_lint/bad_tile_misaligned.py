@@ -1,0 +1,22 @@
+"""Seeded violation: a BlockSpec whose lane dim is not a multiple of
+128 — Mosaic pads 4096x100 to 4096x128, wasting 448 KiB of VMEM.
+
+Expected: exactly one ``tile-align`` on the marked line (the out_spec
+is aligned and stays silent).
+"""
+import jax
+from jax.experimental import pallas as pl
+
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def doubled(x):
+    return pl.pallas_call(
+        _double_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(4,),
+        in_specs=[pl.BlockSpec((4096, 100), lambda i: (i, 0))],  # LINT-HERE
+        out_specs=pl.BlockSpec((4096, 128), lambda i: (i, 0)),
+    )(x)

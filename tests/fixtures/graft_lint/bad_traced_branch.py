@@ -1,0 +1,12 @@
+"""Seeded violation: Python ``if`` on a traced value inside @jax.jit.
+
+Expected: exactly one ``traced-branch`` on the marked line.
+"""
+import jax
+
+
+@jax.jit
+def relu_or_flip(x):
+    if x > 0:  # LINT-HERE
+        return x
+    return -x

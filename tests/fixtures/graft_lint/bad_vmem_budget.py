@@ -1,0 +1,27 @@
+"""Seeded violation: summed residency over the VMEM budget. The input
+tile's index map tracks the inner grid axis so it double-buffers:
+2x4 MiB (in) + 4 MiB (out) + 4 MiB (scratch) = 16 MiB > 75% of 16 MiB.
+
+Expected: exactly one ``vmem-budget`` anchored at the first spec the
+AST walk reaches (the marked line).
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _scan_kernel(x_ref, o_ref, acc_ref):
+    acc_ref[...] += x_ref[...]
+    o_ref[...] = acc_ref[...]
+
+
+def big_scan(x):
+    return pl.pallas_call(
+        _scan_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(2, 16),
+        in_specs=[pl.BlockSpec((1024, 1024), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((1024, 1024), lambda i, j: (i, 0)),  # LINT-HERE
+        scratch_shapes=[pltpu.VMEM((1024, 1024), jnp.float32)],
+    )(x)

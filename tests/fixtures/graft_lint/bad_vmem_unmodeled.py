@@ -1,0 +1,22 @@
+"""Seeded violation: a pallas_call module whose tile shape reads a
+free variable (``width``) and whose file stem has no entry in
+KERNEL_SHAPE_BINDINGS — the kernel runs outside the VMEM model.
+
+Expected: exactly one ``vmem-unmodeled`` on the marked line.
+"""
+import jax
+from jax.experimental import pallas as pl
+
+
+def _window_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def windowed(x, width):
+    return pl.pallas_call(
+        _window_kernel,
+        out_shape=jax.ShapeDtypeStruct((width, 128), x.dtype),
+        grid=(4,),
+        in_specs=[pl.BlockSpec((width, 128), lambda i: (i, 0))],  # LINT-HERE
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+    )(x)

@@ -1,0 +1,50 @@
+"""Clean fixture: the same shapes of code as the bad fixtures, written
+the way graft-lint wants them. Must produce zero violations.
+
+Covers the negative space of every rule: static-arg branches,
+trace-time shape checks, numpy on static values, explicit dtypes,
+module-scope jit, aligned tiles within budget, and a *derived* (not
+hard-coded) chunk budget.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+@functools.partial(jax.jit, static_argnames=("squared",))
+def fold(x, squared=False):
+    if squared:  # static parameter: a Python branch is fine
+        x = x * x
+    if x.ndim == 1:  # .ndim is a trace-time constant
+        x = x[None, :]
+    steps = int(np.prod(x.shape))  # numpy on static shape values: fine
+    ramp = jnp.arange(x.shape[1], dtype=jnp.float32)
+    return jnp.where(x > 0, x, -x) * ramp, steps
+
+
+relu = jax.jit(lambda x: jnp.maximum(x, 0.0))  # module scope, not a loop
+
+
+def _copy_kernel(x_ref, o_ref, acc_ref):
+    acc_ref[...] = x_ref[...]
+    o_ref[...] = acc_ref[...]
+
+
+# derived from the declarations below, not hard-coded — stale-budget
+# only inspects integer-literal assignments
+_COPY_CHUNK_BUDGET = int(16 * 1024 * 1024 * 0.75) - 3 * 256 * 128 * 4
+
+
+def tiled_copy(x):
+    return pl.pallas_call(
+        _copy_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(x.shape[0] // 256,),
+        in_specs=[pl.BlockSpec((256, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((256, 128), lambda i: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((256, 128), jnp.float32)],
+    )(x)

@@ -1,0 +1,13 @@
+"""Suppression fixture: a real traced-value branch silenced with the
+inline ``# graft-lint: ignore[rule-id]`` syntax. Must produce zero
+violations; stripping the suppression comment must produce exactly one
+``traced-branch`` (tests do both).
+"""
+import jax
+
+
+@jax.jit
+def relu_or_flip(x):
+    if x > 0:  # graft-lint: ignore[traced-branch]
+        return x
+    return -x

@@ -128,6 +128,31 @@ class TestCagraSearch:
         recall = float(neighborhood_recall(np.asarray(ann), np.asarray(ref)))
         assert recall >= 0.85, f"recall {recall}"
 
+    def test_recall_planned_width8_default_itopk(self, rng):
+        """The width-8 beam `plan_search_params` hands every
+        default-width caller must hold recall at the DEFAULT itopk (64)
+        — the plan's claim is that widening the beam only cuts the
+        iteration count, not result quality."""
+        n, d, nq, k = 2000, 32, 48, 10
+        X = _data(rng, n, d)
+        Q = _data(rng, nq, d)
+        index = cagra.build(
+            X,
+            CagraIndexParams(
+                intermediate_graph_degree=32,
+                graph_degree=16,
+                build_algo=cagra.IVF_PQ,
+                seed=1,
+            ),
+        )
+        sp = cagra.plan_search_params(nq, k, n)
+        assert sp.itopk_size == CagraSearchParams.itopk_size == 64
+        assert sp.search_width == 8  # the plan's wide-beam promotion
+        _, ref = brute_force.search(brute_force.build(X), Q, k)
+        _, ann = cagra.search(index, Q, k, sp)
+        recall = float(neighborhood_recall(np.asarray(ann), np.asarray(ref)))
+        assert recall >= 0.85, f"recall {recall}"
+
     @pytest.mark.slow
     def test_inner_product(self, rng):
         n, d, nq, k = 2000, 32, 48, 10

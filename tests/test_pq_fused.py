@@ -350,3 +350,73 @@ def test_vmem_decode_cols_cap():
     # byte-spreads + peel temps), so their cap is tighter than u8's
     assert vmem_decode_cols(4096, m=1152, code_mode="b5", ksub=32, bpr=20) < \
         vmem_decode_cols(4096, m=1152, code_mode="u8", ksub=32, bpr=32)
+
+
+def test_vmem_model_reproduces_measured_residency():
+    """The residency model must land within 5% of the measured 17.19 MiB
+    scoped-VMEM allocation of the 1M-row bench shape (m=1152, ksub=256,
+    qt=128, k=10, decode_cols=2048) — the configuration whose Mosaic
+    compile failure motivated the decode cap in the first place."""
+    from raft_tpu.ops.pallas import vmem_model
+
+    res = vmem_model.pq_scan_residency(
+        m=1152, code_mode="u8", ksub=256, bpr=32, qt=128, k=10,
+        decode_cols=2048,
+    )
+    measured = 17.19 * 2**20
+    err = abs(res.total_bytes - measured) / measured
+    assert err < 0.05, f"{res.total_bytes} B vs measured 17.19 MiB " \
+        f"({err:.1%}):\n{res.table()}"
+    # the decode chunk dominates — it is the right knob to solve for
+    assert res.by_name("decode_chunk").nbytes > res.fixed_bytes
+
+
+def test_vmem_model_matches_kernel_scratch_shapes():
+    """The model's scratch entries must mirror the shapes/dtypes the
+    kernel actually declares (``kernel_scratch_shapes``) — this is the
+    drift guard: changing the kernel's scratch without updating the
+    model fails here, not in a Mosaic compile on TPU."""
+    from raft_tpu.ops.pallas import vmem_model
+    from raft_tpu.ops.pallas.ivf_scan import _eff_banks
+    from raft_tpu.ops.pallas.pq_scan import kernel_scratch_shapes
+
+    for m, merge, qt, k in [
+        (1152, "bank8", 128, 10), (256, "bank8", 128, 128),
+        (1152, "bank4", 64, 10), (100, "bank8", 128, 10),
+    ]:
+        banks = _eff_banks(merge, m, 0)
+        assert vmem_model.merge_banks(merge, m) == banks, (merge, m)
+        res = vmem_model.pq_scan_residency(
+            m=m, code_mode="u8", ksub=256, bpr=32, qt=qt, k=k, merge=merge,
+        )
+        model_scratch = [r for r in res.residents if r.kind == "scratch"]
+        decls = kernel_scratch_shapes(qt, k, banks)
+        assert len(model_scratch) == len(decls)
+        for r, decl in zip(model_scratch, decls):
+            assert tuple(decl.shape) == r.shape, r.name
+            assert jnp.dtype(decl.dtype).itemsize == r.itemsize, r.name
+
+
+def test_decode_budget_is_derived_not_hardcoded():
+    """The hand-calibrated 8 MB ``_DECODE_CHUNK_BUDGET`` constant is
+    gone; the budget now comes from the residency model (headroom x
+    16 MiB minus fixed residents) and therefore moves with shape."""
+    from raft_tpu.ops.pallas import pq_scan, vmem_model
+
+    assert not hasattr(pq_scan, "_DECODE_CHUNK_BUDGET")
+    # at the calibration shape the derivation reproduces the historical
+    # constant (that is what pinned VMEM_HEADROOM = 0.75)
+    budget = vmem_model.pq_decode_chunk_budget(
+        m=1152, code_mode="u8", ksub=256, bpr=32, k=10,
+    )
+    assert abs(budget - 8_000_000) / 8_000_000 < 0.02, budget
+    # unlike the constant, the budget shrinks as fixed residents grow
+    # (longer lists -> bigger dot accumulator + code DMA buffers)
+    wider = vmem_model.pq_decode_chunk_budget(
+        m=4608, code_mode="u8", ksub=256, bpr=32, k=10,
+    )
+    assert wider < budget
+    # and the kernel-side wrapper agrees with the model
+    assert pq_scan._decode_chunk_budget(
+        m=1152, code_mode="u8", ksub=256, bpr=32, k=10,
+    ) == budget

@@ -1,0 +1,30 @@
+"""graft-lint — static analysis for JAX/Pallas code with a VMEM
+resource model.
+
+Usage::
+
+    python -m tools.graft_lint raft_tpu/          # lint a tree
+    python -m tools.graft_lint --list-rules       # what gets checked
+
+Library API: :func:`run_lint` / :func:`lint_source` return
+:class:`Violation` lists; the tier-1 suite runs the former over
+``raft_tpu/`` (``tests/test_graft_lint_repo.py``) so any unsuppressed
+violation fails CI. See ``docs/static_analysis.md``.
+"""
+from tools.graft_lint.core import (
+    Checker,
+    LintModule,
+    Violation,
+    all_checkers,
+    lint_source,
+    run_lint,
+)
+
+__all__ = [
+    "Checker",
+    "LintModule",
+    "Violation",
+    "all_checkers",
+    "lint_source",
+    "run_lint",
+]

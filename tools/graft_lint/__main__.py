@@ -1,0 +1,65 @@
+"""CLI: ``python -m tools.graft_lint [paths...]``.
+
+Exit status: 0 = clean, 1 = violations found, 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.graft_lint.core import all_checkers, run_lint
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graft-lint",
+        description="JAX/Pallas static analysis with a VMEM resource model.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["raft_tpu"],
+        help="files or directories to lint (default: raft_tpu)",
+    )
+    parser.add_argument(
+        "--select", metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--ignore", metavar="RULES",
+        help="comma-separated rule ids to skip",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit violations as JSON"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rule ids and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for c in all_checkers():
+            print(f"{c.rule:16s} {c.doc}")
+        return 0
+
+    try:
+        violations = run_lint(
+            args.paths,
+            select=args.select.split(",") if args.select else None,
+            ignore=args.ignore.split(",") if args.ignore else None,
+        )
+    except ValueError as e:
+        print(f"graft-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps([v.__dict__ for v in violations], indent=2))
+    else:
+        for v in violations:
+            print(v.render())
+        if violations:
+            print(f"graft-lint: {len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

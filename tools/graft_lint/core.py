@@ -1,0 +1,194 @@
+"""graft-lint core: file model, suppression handling, checker registry.
+
+An AST-based static-analysis pass for JAX/Pallas code. The reference
+project pairs its kernels with compile-time correctness tooling
+(template checks, sanitizer CI); graft-lint is the analog for a traced
+Python codebase — it never imports the code under analysis, it parses
+it. Two checker families plug in here:
+
+* :mod:`tools.graft_lint.jax_rules` — JAX tracing/correctness lints
+  (traced-value branches, numpy calls in jitted paths, static-arg
+  declarations, jit-in-loop recompilation hazards, implicit dtypes);
+* :mod:`tools.graft_lint.pallas_rules` — a VMEM resource model for
+  Pallas kernels (tile alignment, residency budgets, stale hard-coded
+  byte budgets).
+
+Suppression syntax (checked against the violation's reported line)::
+
+    x = np.cumsum(h)      # graft-lint: ignore[numpy-in-jit]
+    y = risky(x)          # graft-lint: ignore          (all rules)
+    # graft-lint: skip-file                             (whole module)
+
+Checkers are approximate by design: they flag patterns that are nearly
+always hazards and accept an inline suppression where a human judged
+the pattern safe. They must never crash on weird-but-valid code — a
+checker that cannot decide stays silent.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graft-lint\s*:\s*ignore(?:\[(?P<rules>[\w\-, ]+)\])?"
+)
+_SKIP_FILE_RE = re.compile(r"#\s*graft-lint\s*:\s*skip-file")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding: ``path:line:col: rule-id message``."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class Checker:
+    """Base checker. Subclasses set ``rule`` (kebab-case id) and ``doc``
+    (one-line description for ``--list-rules``/docs) and implement
+    :meth:`check` yielding :class:`Violation`."""
+
+    rule: str = ""
+    doc: str = ""
+
+    def check(self, module: "LintModule") -> Iterator[Violation]:
+        raise NotImplementedError
+
+    def violation(self, module: "LintModule", node: ast.AST, message: str) -> Violation:
+        return Violation(
+            rule=self.rule,
+            path=module.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+        )
+
+
+class LintModule:
+    """One parsed source file plus its suppression map."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.skip_file = False
+        # line -> set of suppressed rule ids; "*" suppresses every rule
+        self.suppressions: Dict[int, Set[str]] = {}
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                if _SKIP_FILE_RE.search(tok.string):
+                    self.skip_file = True
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = m.group("rules")
+                ids = (
+                    {r.strip() for r in rules.split(",") if r.strip()}
+                    if rules
+                    else {"*"}
+                )
+                self.suppressions.setdefault(tok.start[0], set()).update(ids)
+        except tokenize.TokenError:
+            pass  # partial comment map beats crashing the lint
+
+    def suppressed(self, v: Violation) -> bool:
+        ids = self.suppressions.get(v.line, set())
+        return "*" in ids or v.rule in ids
+
+
+def all_checkers() -> List[Checker]:
+    """The default checker set, import-cycle-free registry."""
+    from tools.graft_lint import jax_rules, pallas_rules
+
+    return [*jax_rules.CHECKERS, *pallas_rules.CHECKERS]
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    """Expand files/directories to .py files, skipping caches, hidden
+    dirs, and generated notebook exports."""
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_source(
+    path: str,
+    source: str,
+    checkers: Optional[Iterable[Checker]] = None,
+) -> List[Violation]:
+    """Lint one in-memory source buffer. Parse errors surface as a
+    single ``parse-error`` violation so broken files fail loudly rather
+    than silently passing the gate."""
+    try:
+        module = LintModule(path, source)
+    except SyntaxError as e:
+        return [
+            Violation(
+                rule="parse-error", path=path, line=e.lineno or 1,
+                col=(e.offset or 0) + 1 if e.offset else 1,
+                message=f"could not parse: {e.msg}",
+            )
+        ]
+    if module.skip_file:
+        return []
+    out: List[Violation] = []
+    for checker in checkers if checkers is not None else all_checkers():
+        for v in checker.check(module):
+            if not module.suppressed(v):
+                out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
+def run_lint(
+    paths: Sequence[str],
+    select: Optional[Sequence[str]] = None,
+    ignore: Optional[Sequence[str]] = None,
+) -> List[Violation]:
+    """Lint files/directories; returns unsuppressed violations sorted by
+    location. ``select``/``ignore`` filter by rule id."""
+    checkers = all_checkers()
+    if select:
+        wanted = set(select)
+        unknown = wanted - {c.rule for c in checkers}
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+        checkers = [c for c in checkers if c.rule in wanted]
+    if ignore:
+        checkers = [c for c in checkers if c.rule not in set(ignore)]
+    out: List[Violation] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        out.extend(lint_source(path, source, checkers))
+    return out

@@ -1,0 +1,545 @@
+"""JAX tracing/correctness checkers.
+
+These rules encode the tracing invariants that turn into runtime
+``TracerBoolConversionError``s, silent host round-trips, or
+recompilation storms — the failure modes the reference project catches
+with compile-time template checks and we can only catch by reading the
+AST:
+
+* ``traced-branch``   — Python ``if``/``while`` on a traced value
+  inside a ``@jax.jit`` function (trace-time crash or silent
+  specialization).
+* ``numpy-in-jit``    — ``np.*`` called on a traced value inside a
+  jitted function (forces a host transfer / breaks tracing).
+* ``static-args``     — ``static_argnames`` naming a parameter that
+  does not exist, ``static_argnums`` out of range, or a static
+  parameter with a non-hashable default.
+* ``jit-in-loop``     — ``jax.jit`` (or ``partial(jax.jit, ...)``)
+  constructed inside a loop: every iteration builds a fresh wrapper
+  with an empty compilation cache.
+* ``implicit-dtype``  — ``jnp.arange``/``jnp.linspace`` with float
+  arguments and no explicit ``dtype``: the result dtype flips between
+  f32 and f64 with the ``jax_enable_x64`` flag.
+
+The taint analysis is a deliberate approximation: a name is *traced* if
+it is a non-static parameter of the jitted function or was assigned
+from an expression that reads a traced name outside a static context
+(``.shape``/``.ndim``/``.dtype``/``.size``, ``len()``, ``isinstance``,
+``x is None``). No interprocedural propagation — helpers called from a
+jitted function are each analyzed only if jitted themselves.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.graft_lint.core import Checker, LintModule, Violation
+
+# attribute reads that yield trace-time constants even on tracers
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "weak_type"}
+# calls whose result is a Python value at trace time (or that fail
+# loudly on tracers anyway, which is not this rule's business)
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "int", "float", "bool", "str"}
+
+
+class _Imports(ast.NodeVisitor):
+    """Module-level import aliases for numpy / jax / jax.numpy /
+    functools.partial / jax.jit."""
+
+    def __init__(self) -> None:
+        self.numpy: Set[str] = set()
+        self.jax: Set[str] = set()
+        self.jnp: Set[str] = set()
+        self.jit: Set[str] = set()       # names bound directly to jax.jit
+        self.partial: Set[str] = set()   # names bound to functools.partial
+        self.functools: Set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            if a.name == "numpy":
+                self.numpy.add(a.asname or "numpy")
+            elif a.name == "jax":
+                self.jax.add(a.asname or "jax")
+            elif a.name == "jax.numpy":
+                self.jnp.add(a.asname or name)
+            elif a.name == "functools":
+                self.functools.add(a.asname or "functools")
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for a in node.names:
+            bound = a.asname or a.name
+            if node.module == "jax" and a.name == "numpy":
+                self.jnp.add(bound)
+            elif node.module == "jax" and a.name == "jit":
+                self.jit.add(bound)
+            elif node.module == "functools" and a.name == "partial":
+                self.partial.add(bound)
+            elif node.module == "numpy":
+                pass  # from numpy import X — too fine-grained to track
+
+
+def _module_imports(module: LintModule) -> _Imports:
+    cached = getattr(module, "_graft_imports", None)
+    if cached is None:
+        cached = _Imports()
+        cached.visit(module.tree)
+        module._graft_imports = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def _is_jit_expr(node: ast.AST, imp: _Imports) -> bool:
+    """``jax.jit`` / ``jit`` (imported from jax)."""
+    if isinstance(node, ast.Name):
+        return node.id in imp.jit
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id in imp.jax
+    )
+
+
+def _is_partial_expr(node: ast.AST, imp: _Imports) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in imp.partial
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "partial"
+        and isinstance(node.value, ast.Name)
+        and node.value.id in imp.functools
+    )
+
+
+def _jit_call_keywords(node: ast.AST, imp: _Imports) -> Optional[List[ast.keyword]]:
+    """If ``node`` is a jit construction (``jax.jit``, ``jax.jit(...)``,
+    ``partial(jax.jit, ...)``), return its keyword list (may be empty);
+    else None."""
+    if _is_jit_expr(node, imp):
+        return []
+    if isinstance(node, ast.Call):
+        if _is_jit_expr(node.func, imp):
+            return list(node.keywords)
+        if (
+            _is_partial_expr(node.func, imp)
+            and node.args
+            and _is_jit_expr(node.args[0], imp)
+        ):
+            return list(node.keywords)
+    return None
+
+
+def _const_str_seq(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _const_int_seq(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _static_params(fn: ast.FunctionDef, keywords: Sequence[ast.keyword]) -> Set[str]:
+    """Parameter names declared static via static_argnames/argnums."""
+    statics: Set[str] = set()
+    pos = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            statics.update(_const_str_seq(kw.value) or [])
+        elif kw.arg == "static_argnums":
+            for i in _const_int_seq(kw.value) or []:
+                if -len(pos) <= i < len(pos):
+                    statics.add(pos[i])
+    return statics
+
+
+def iter_jitted_functions(
+    module: LintModule,
+) -> Iterator[Tuple[ast.FunctionDef, List[ast.keyword], ast.AST]]:
+    """(function def, jit keywords, decorator node) for every function
+    whose decorator list contains a jit construction."""
+    imp = _module_imports(module)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            kws = _jit_call_keywords(deco, imp)
+            if kws is not None:
+                yield node, kws, deco
+                break
+
+
+def _is_none_check(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Compare)
+        and len(node.ops) == 1
+        and isinstance(node.ops[0], (ast.Is, ast.IsNot))
+        and (
+            (isinstance(node.comparators[0], ast.Constant) and node.comparators[0].value is None)
+            or (isinstance(node.left, ast.Constant) and node.left.value is None)
+        )
+    )
+
+
+def _tainted(node: Optional[ast.AST], traced: Set[str]) -> bool:
+    """Does this expression read a traced name in a value position?"""
+    if node is None:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _tainted(node.value, traced)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id in _STATIC_CALLS:
+            return False
+        return any(
+            _tainted(c, traced)
+            for c in [node.func, *node.args, *[k.value for k in node.keywords]]
+        )
+    if _is_none_check(node):
+        return False
+    if isinstance(node, ast.Lambda):
+        shadow = {p.arg for p in node.args.posonlyargs + node.args.args + node.args.kwonlyargs}
+        return _tainted(node.body, traced - shadow)
+    if isinstance(node, ast.Constant):
+        return False
+    return any(_tainted(c, traced) for c in ast.iter_child_nodes(node))
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return [n for e in target.elts for n in _target_names(e)]
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    return []  # attribute / subscript targets: not name bindings
+
+
+class _JitBodyEvents:
+    """Events collected in one ordered pass over a jitted function."""
+
+    def __init__(self) -> None:
+        self.dynamic_tests: List[Tuple[str, ast.AST]] = []  # ("if"|"while", node)
+        self.numpy_calls: List[ast.Call] = []
+
+
+def _scan_exprs_for_numpy(
+    exprs: Sequence[Optional[ast.AST]],
+    traced: Set[str],
+    imp: _Imports,
+    events: _JitBodyEvents,
+) -> None:
+    for expr in exprs:
+        if expr is None:
+            continue
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in imp.numpy
+            ):
+                continue
+            args = [*node.args, *[k.value for k in node.keywords]]
+            if any(_tainted(a, traced) for a in args):
+                events.numpy_calls.append(node)
+
+
+def _stmt_exprs(stmt: ast.stmt) -> List[Optional[ast.AST]]:
+    """The expression fields owned by one statement (no child stmts)."""
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value]
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value]
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.value]
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Return):
+        return [stmt.value]
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter]
+    if isinstance(stmt, ast.With):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test, stmt.msg]
+    if isinstance(stmt, ast.Raise):
+        return [stmt.exc, stmt.cause]
+    return []
+
+
+def _walk_jit_body(
+    body: Sequence[ast.stmt],
+    traced: Set[str],
+    imp: _Imports,
+    events: _JitBodyEvents,
+) -> None:
+    """Ordered walk: propagate taint through assignments, record
+    dynamic ``if``/``while`` tests and numpy-on-traced calls."""
+    for stmt in body:
+        _scan_exprs_for_numpy(_stmt_exprs(stmt), traced, imp, events)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def (kernel closure, scan body): closure names keep
+            # their taint, fresh params shadow as untraced
+            shadow = traced - set(_param_names(stmt)) - {stmt.name}
+            _walk_jit_body(stmt.body, shadow, imp, events)
+            traced.discard(stmt.name)
+        elif isinstance(stmt, ast.Assign):
+            tainted = _tainted(stmt.value, traced)
+            for t in stmt.targets:
+                for name in _target_names(t):
+                    (traced.add if tainted else traced.discard)(name)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None and _tainted(stmt.value, traced):
+                for name in _target_names(stmt.target):
+                    traced.add(name)
+        elif isinstance(stmt, ast.If):
+            if _tainted(stmt.test, traced):
+                events.dynamic_tests.append(("if", stmt))
+            _walk_jit_body(stmt.body, traced, imp, events)
+            _walk_jit_body(stmt.orelse, traced, imp, events)
+        elif isinstance(stmt, ast.While):
+            if _tainted(stmt.test, traced):
+                events.dynamic_tests.append(("while", stmt))
+            _walk_jit_body(stmt.body, traced, imp, events)
+            _walk_jit_body(stmt.orelse, traced, imp, events)
+        elif isinstance(stmt, ast.For):
+            if _tainted(stmt.iter, traced):
+                for name in _target_names(stmt.target):
+                    traced.add(name)
+            _walk_jit_body(stmt.body, traced, imp, events)
+            _walk_jit_body(stmt.orelse, traced, imp, events)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                if item.optional_vars is not None and _tainted(
+                    item.context_expr, traced
+                ):
+                    for name in _target_names(item.optional_vars):
+                        traced.add(name)
+            _walk_jit_body(stmt.body, traced, imp, events)
+        elif isinstance(stmt, ast.Try):
+            _walk_jit_body(stmt.body, traced, imp, events)
+            for h in stmt.handlers:
+                _walk_jit_body(h.body, traced, imp, events)
+            _walk_jit_body(stmt.orelse, traced, imp, events)
+            _walk_jit_body(stmt.finalbody, traced, imp, events)
+
+
+def _analyze_jitted(
+    module: LintModule, fn: ast.FunctionDef, keywords: Sequence[ast.keyword]
+) -> _JitBodyEvents:
+    cache: Dict[int, _JitBodyEvents] = getattr(module, "_graft_jit_cache", None) or {}
+    key = id(fn)
+    if key not in cache:
+        imp = _module_imports(module)
+        statics = _static_params(fn, keywords)
+        traced = set(_param_names(fn)) - statics
+        events = _JitBodyEvents()
+        _walk_jit_body(fn.body, traced, imp, events)
+        cache[key] = events
+        module._graft_jit_cache = cache  # type: ignore[attr-defined]
+    return cache[key]
+
+
+class TracedBranchChecker(Checker):
+    rule = "traced-branch"
+    doc = (
+        "Python if/while on a traced value inside a @jax.jit function — "
+        "use lax.cond/lax.while_loop/jnp.where, or declare the argument "
+        "static."
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        for fn, kws, _ in iter_jitted_functions(module):
+            events = _analyze_jitted(module, fn, kws)
+            for kind, node in events.dynamic_tests:
+                yield self.violation(
+                    module, node,
+                    f"Python `{kind}` tests a traced value inside jitted "
+                    f"`{fn.name}` — this fails (or silently specializes) at "
+                    "trace time; use lax.cond/lax.while_loop/jnp.where or "
+                    "mark the argument static",
+                )
+
+
+class NumpyInJitChecker(Checker):
+    rule = "numpy-in-jit"
+    doc = (
+        "np.* called on a traced value inside a @jax.jit function — "
+        "forces a host transfer at trace time; use jnp.*."
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        for fn, kws, _ in iter_jitted_functions(module):
+            events = _analyze_jitted(module, fn, kws)
+            for call in events.numpy_calls:
+                attr = call.func.attr if isinstance(call.func, ast.Attribute) else "?"
+                yield self.violation(
+                    module, call,
+                    f"np.{attr}(...) receives a traced value inside jitted "
+                    f"`{fn.name}` — numpy cannot trace; use the jnp "
+                    "equivalent",
+                )
+
+
+class StaticArgsChecker(Checker):
+    rule = "static-args"
+    doc = (
+        "static_argnames naming a missing parameter, static_argnums out "
+        "of range, or a static parameter with a non-hashable default."
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        for fn, kws, deco in iter_jitted_functions(module):
+            params = set(_param_names(fn))
+            pos = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+            for kw in kws:
+                if kw.arg == "static_argnames":
+                    for name in _const_str_seq(kw.value) or []:
+                        if name not in params:
+                            yield self.violation(
+                                module, deco,
+                                f"static_argnames names `{name}` which is not "
+                                f"a parameter of `{fn.name}` — jax raises at "
+                                "first call",
+                            )
+                elif kw.arg == "static_argnums":
+                    for i in _const_int_seq(kw.value) or []:
+                        if not (-len(pos) <= i < len(pos)):
+                            yield self.violation(
+                                module, deco,
+                                f"static_argnums index {i} is out of range for "
+                                f"`{fn.name}` ({len(pos)} positional params)",
+                            )
+            # non-hashable defaults on static params leak into the jit
+            # cache key and raise at call time
+            statics = _static_params(fn, kws)
+            defaults = fn.args.defaults
+            pos_with_default = pos[len(pos) - len(defaults):] if defaults else []
+            kw_pairs = zip(fn.args.kwonlyargs, fn.args.kw_defaults)
+            pairs = list(zip(pos_with_default, defaults)) + [
+                (p.arg, d) for p, d in kw_pairs if d is not None
+            ]
+            for name, default in pairs:
+                if name in statics and isinstance(
+                    default, (ast.List, ast.Dict, ast.Set)
+                ):
+                    yield self.violation(
+                        module, default,
+                        f"static parameter `{name}` of `{fn.name}` has a "
+                        "non-hashable default — jit cache keys must be "
+                        "hashable; use a tuple/frozenset",
+                    )
+
+
+class JitInLoopChecker(Checker):
+    rule = "jit-in-loop"
+    doc = (
+        "jax.jit (or partial(jax.jit, ...)) constructed inside a loop — "
+        "every iteration builds a fresh wrapper and recompiles; hoist "
+        "the jitted function out of the loop."
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        imp = _module_imports(module)
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if node is loop:
+                    continue
+                if isinstance(node, ast.Call) and _jit_call_keywords(node, imp) is not None:
+                    yield self.violation(
+                        module, node,
+                        "jax.jit constructed inside a loop — each iteration "
+                        "makes a fresh wrapper with an empty compile cache; "
+                        "hoist it out of the loop",
+                    )
+
+
+def _has_float_arg(call: ast.Call) -> bool:
+    for a in call.args:
+        v = a
+        if isinstance(v, ast.UnaryOp):
+            v = v.operand
+        if isinstance(v, ast.Constant) and isinstance(v.value, float):
+            return True
+    return False
+
+
+class ImplicitDtypeChecker(Checker):
+    rule = "implicit-dtype"
+    doc = (
+        "jnp.arange/linspace with float arguments and no explicit dtype "
+        "— the result flips f32/f64 with the jax_enable_x64 flag."
+    )
+
+    _FNS = {"arange", "linspace", "geomspace", "logspace"}
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        imp = _module_imports(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in self._FNS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in imp.jnp
+            ):
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            # arange(start, stop, step, dtype) — 4th positional is dtype
+            if f.attr == "arange" and len(node.args) >= 4:
+                continue
+            if _has_float_arg(node):
+                yield self.violation(
+                    module, node,
+                    f"jnp.{f.attr} with float arguments and no dtype — the "
+                    "result dtype depends on the jax_enable_x64 flag; pass "
+                    "an explicit dtype",
+                )
+
+
+CHECKERS = [
+    TracedBranchChecker(),
+    NumpyInJitChecker(),
+    StaticArgsChecker(),
+    JitInLoopChecker(),
+    ImplicitDtypeChecker(),
+]
