@@ -95,6 +95,13 @@ class CagraSearchParams:
     max_iterations: int = 0  # 0 = auto (search_plan.cuh:136 adjust)
     seed: int = 0
     init_sample: int = 4096
+    # fused (Pallas) path knobs — see ops/pallas/cagra_search.py. ``qt``
+    # is the per-grid-step query tile (VMEM-modeled at 32);
+    # ``fused_table_dtype`` trades table HBM footprint for score
+    # precision (bf16 halves the deg-x table; use float32 for
+    # bit-faithful parity runs).
+    fused_qt: int = 32
+    fused_table_dtype: str = "bfloat16"
     # Candidate deduplication strategy per iteration:
     #   "sort" — id-sort + adjacent-compare + re-select (two sorts; the
     #            round-3 default, exact).
@@ -433,6 +440,33 @@ def _pick_positions(vals, w: int, worst):
     return jnp.concatenate(poss, axis=1), jnp.concatenate(valids, axis=1)
 
 
+def _seed_select(qf, q_sqnorm, vecs, vsq, init_ids, *, itopk, select_min, worst,
+                 filter_bits, has_filter):
+    """Score the shared strided seed rows (one [nq, S] MXU matmul — the
+    ``dev_seed`` analog) and select the initial ``itopk`` beam. Shared
+    by the XLA and fused search paths so both start from an IDENTICAL
+    beam: (values, ids) with ``worst``/-1 in unfilled slots."""
+    s = init_ids.shape[0]
+    dots = jnp.dot(
+        qf, vecs.T, preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST
+    )
+    if select_min:
+        sample_d = jnp.maximum(q_sqnorm[:, None] + vsq[None, :] - 2.0 * dots, 0.0)
+    else:
+        sample_d = dots
+    if has_filter:
+        word = filter_bits[init_ids // 32]
+        bit = (word >> (init_ids % 32).astype(jnp.uint32)) & 1
+        sample_d = jnp.where((bit == 1)[None, :], sample_d, worst)
+    kk = min(itopk, s)
+    v0, pos = select_k(sample_d, kk, select_min=select_min)
+    i0 = jnp.where(v0 != worst, init_ids[pos], -1)
+    if kk < itopk:
+        v0 = jnp.pad(v0, ((0, 0), (0, itopk - kk)), constant_values=worst)
+        i0 = jnp.pad(i0, ((0, 0), (0, itopk - kk)), constant_values=-1)
+    return v0, i0
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -519,27 +553,11 @@ def _cagra_search_impl(
         # shared strided sample (dev_seed analog): all queries score the
         # same S rows, so the gather is [S, d] once and the scoring is one
         # MXU matmul — no [nq, S, d] blowup
-        s = init_ids.shape[0]
-        vecs = gather_vecs(init_ids[None, :])[0]  # [s, d]
-        dots = jnp.dot(
-            qf, vecs.T, preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST
+        v0, i0 = _seed_select(
+            qf, q_sqnorm, gather_vecs(init_ids[None, :])[0], sqnorms[init_ids],
+            init_ids, itopk=itopk, select_min=select_min, worst=worst,
+            filter_bits=filter_bits, has_filter=has_filter,
         )
-        if select_min:
-            sample_d = jnp.maximum(
-                q_sqnorm[:, None] + sqnorms[init_ids][None, :] - 2.0 * dots, 0.0
-            )
-        else:
-            sample_d = dots
-        if has_filter:
-            word = filter_bits[init_ids // 32]
-            bit = (word >> (init_ids % 32).astype(jnp.uint32)) & 1
-            sample_d = jnp.where((bit == 1)[None, :], sample_d, worst)
-        kk = min(itopk, s)
-        v0, pos = select_k(sample_d, kk, select_min=select_min)
-        i0 = jnp.where(v0 != worst, init_ids[pos], -1)
-        if kk < itopk:
-            v0 = jnp.pad(v0, ((0, 0), (0, itopk - kk)), constant_values=worst)
-            i0 = jnp.pad(i0, ((0, 0), (0, itopk - kk)), constant_values=-1)
         buf_v, buf_i, buf_f = v0, i0, jnp.zeros((nq, itopk), bool)
     else:
         init_d = score(init_ids)
@@ -706,6 +724,107 @@ def derive_search_config(params: "CagraSearchParams", k: int, size: int):
     return itopk, width, iters, min(itopk, size)
 
 
+def fused_eligible(
+    index: CagraIndex,
+    params: "CagraSearchParams",
+    prefilter: Optional[Bitset] = None,
+) -> bool:
+    """Whether the Pallas fused beam kernel
+    (:mod:`raft_tpu.ops.pallas.cagra_search`) can serve this search:
+    raw (uncompressed) dataset, shared strided seeding, ``"post"``
+    dedup semantics (the kernel's merge implements exactly those), no
+    prefilter, ids within the packed base-256 encoding, and id rows
+    that fit the vector lanes (``graph_degree <= dim``)."""
+    from raft_tpu.ops.pallas.cagra_search import MAX_TABLE_IDS
+
+    return (
+        index.dataset is not None
+        and prefilter is None
+        and params.init_sample > 0
+        and params.dedup == "post"
+        and index.metric in _SUPPORTED
+        and index.graph_degree <= index.dim
+        and index.size <= MAX_TABLE_IDS
+    )
+
+
+def _fused_table(index: CagraIndex, dtype) -> jax.Array:
+    """Build (once) and cache the packed ``[n, deg + 3, d]`` neighbor
+    table on the index. Plain attribute, not a pytree leaf — transforms
+    never see it, and a rebuilt index starts with a cold cache."""
+    from raft_tpu.ops.pallas.cagra_search import build_neighbor_table
+
+    dtype = jnp.dtype(dtype)
+    cached = getattr(index, "_fused_table_cache", None)
+    if cached is None or cached[0] != dtype:
+        table = build_neighbor_table(index.dataset, index.graph, dtype=dtype)
+        cached = (dtype, table)
+        index._fused_table_cache = cached
+    return cached[1]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "itopk", "width", "iters", "metric", "qt", "interpret"),
+)
+def _cagra_fused_impl(
+    table,
+    dataset,
+    sqnorms,
+    queries,
+    init_ids,
+    *,
+    k: int,
+    itopk: int,
+    width: int,
+    iters: int,
+    metric: DistanceType,
+    qt: int,
+    interpret: bool,
+):
+    """Fused-path wrapper: identical seed beam to the XLA path (shared
+    :func:`_seed_select`), the Pallas beam loop, then the same final
+    unique-merge + metric epilogue as ``_cagra_search_impl``. The final
+    merge also collapses the one dup class the in-kernel adjacent kill
+    cannot see: a seed node rescored during expansion carries the
+    kernel's arithmetic, not the init matmul's, so the two copies are
+    not value-adjacent."""
+    from raft_tpu.ops.pallas.cagra_search import WORST as KWORST
+    from raft_tpu.ops.pallas.cagra_search import cagra_fused_search
+
+    nq, _ = queries.shape
+    qf = queries.astype(jnp.float32)
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.asarray(worst_value(jnp.float32, select_min), jnp.float32)
+    q_sqnorm = jnp.sum(qf * qf, axis=1)
+    v0, i0 = _seed_select(
+        qf, q_sqnorm, dataset[init_ids].astype(jnp.float32), sqnorms[init_ids],
+        init_ids, itopk=itopk, select_min=select_min, worst=worst,
+        filter_bits=None, has_filter=False,
+    )
+    # kernel beam is min-ordered with a finite worst: negate IP dots,
+    # map empty slots, pack (id, visited=0) into one lane
+    kv0 = jnp.where(i0 < 0, KWORST, v0 if select_min else -v0)
+    kidf0 = jnp.where(i0 < 0, -1, i0 * 2)
+    bv, bidf = cagra_fused_search(
+        table, qf, kv0, kidf0,
+        itopk=itopk, width=width, iters=iters, qt=qt,
+        ip=not select_min, interpret=interpret,
+    )
+    buf_i = bidf >> 1
+    buf_f = (bidf & 1) == 1
+    buf_v = jnp.where(buf_i < 0, worst, bv if select_min else -bv)
+    buf_v, buf_i, buf_f = running_merge_unique(
+        buf_v, buf_i,
+        jnp.full((nq, 1), worst, jnp.float32), jnp.full((nq, 1), -1, jnp.int32),
+        select_min=select_min, acc_flags=buf_f,
+    )
+    vals, idx = buf_v[:, :k], buf_i[:, :k]
+    if metric == DistanceType.L2SqrtExpanded:
+        vals = jnp.where(idx >= 0, jnp.sqrt(jnp.maximum(vals, 0.0)), vals)
+    return vals, idx
+
+
 def search(
     index: CagraIndex,
     queries,
@@ -714,11 +833,19 @@ def search(
     prefilter: Optional[Bitset] = None,
     query_batch: int = 1024,
     res: Optional[Resources] = None,
+    mode: str = "auto",
     **kwargs,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy beam search over the graph (``cagra::search``,
     ``detail/cagra/cagra_search.cuh:249``). Returns best-first
-    ``(distances [nq, k], indices [nq, k])``; unfilled slots get id -1."""
+    ``(distances [nq, k], indices [nq, k])``; unfilled slots get id -1.
+
+    ``mode``: ``"fused"`` = the Pallas DMA-fed beam kernel
+    (:mod:`raft_tpu.ops.pallas.cagra_search`) — beam state VMEM-resident
+    across iterations, parents' packed neighbor rows streamed HBM->VMEM;
+    ``"xla"`` = the gather/einsum/select loop (the fallback and the
+    recall oracle the fused path is tested against); ``"auto"`` picks
+    fused on TPU when :func:`fused_eligible`, else xla."""
     ensure_resources(res)
     if params is None:
         params = CagraSearchParams(**kwargs)
@@ -735,6 +862,20 @@ def search(
         expects(prefilter.size >= index.size, "prefilter smaller than index")
     filter_bits = prefilter.bits if prefilter is not None else None
 
+    if mode == "auto":
+        mode = (
+            "fused"
+            if jax.default_backend() == "tpu" and fused_eligible(index, params, prefilter)
+            else "xla"
+        )
+    expects(mode in ("xla", "fused"), "mode must be auto|xla|fused, got %r", mode)
+    if mode == "fused":
+        expects(
+            fused_eligible(index, params, prefilter),
+            "fused mode needs a raw dataset, init_sample > 0, dedup='post', "
+            "no prefilter, and graph_degree <= dim (use mode='xla')",
+        )
+
     nq = queries.shape[0]
     key = as_key(params.seed)
 
@@ -750,6 +891,27 @@ def search(
         else:
             key, kb = jax.random.split(key)
             init_ids = jax.random.randint(kb, (qc.shape[0], n_init), 0, index.size, jnp.int32)
+        if mode == "fused":
+            table = _fused_table(index, params.fused_table_dtype)
+            v, i = _cagra_fused_impl(
+                table,
+                index.dataset,
+                index.sqnorms,
+                qc,
+                init_ids,
+                k=k,
+                itopk=itopk,
+                width=width,
+                iters=iters,
+                metric=index.metric,
+                qt=max(8, min(params.fused_qt, -(-qc.shape[0] // 8) * 8)),
+                interpret=jax.default_backend() != "tpu",
+            )
+            if bpad:
+                v, i = v[:-bpad], i[:-bpad]
+            out_v.append(v)
+            out_i.append(i)
+            continue
         use_vpq = index.dataset is None
         vpq_arrays = None
         sqnorms = index.sqnorms

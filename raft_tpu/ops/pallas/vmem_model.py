@@ -221,6 +221,48 @@ def pq_decode_chunk_budget(
     return int(limit * headroom) - fixed
 
 
+def cagra_search_residency(
+    *,
+    itopk: int = 160,
+    width: int = 8,
+    deg: int = 16,
+    d: int = 128,
+    qt: int = 32,
+    table_itemsize: int = 2,
+) -> KernelResidency:
+    """Model ``cagra_search._beam_kernel``'s residency for one grid
+    step (one ``qt``-query tile; the grid is 1-D over query tiles, so
+    every tile moves with the innermost axis and double-buffers).
+    Defaults are the 1M-row bench shape (itopk<=160, width 8, the
+    bf16 packed table). The SMEM parent-id staging buffer
+    (``[qt, width]`` i32) is not VMEM and is excluded.
+
+    ``table_itemsize`` follows ``CagraSearchParams.fused_table_dtype``
+    (2 = bf16 default, 4 = the float32 parity table)."""
+    m = itopk + width * deg
+    residents = [
+        # in tiles (queries, init beam) + out tiles (final beam); the
+        # out tiles double as the across-iteration beam state
+        Resident("q_tile", (qt, d), 4, buffers=2),
+        Resident("init_v", (qt, itopk), 4, buffers=2),
+        Resident("init_idf", (qt, itopk), 4, buffers=2),
+        Resident("out_v", (qt, itopk), 4, buffers=2),
+        Resident("out_idf", (qt, itopk), 4, buffers=2),
+        # scratch_shapes, in declaration order (table stays in HBM/ANY
+        # and is streamed by explicit per-parent DMAs into nbr)
+        Resident("nbr", (qt, width * (deg + 3), d), table_itemsize, kind="scratch"),
+        Resident("parents", (qt, width), 4, kind="scratch"),
+        Resident("cand_v", (qt, width * deg), 4, kind="scratch"),
+        Resident("cand_id", (qt, width * deg), 4, kind="scratch"),
+        # peak kernel-body intermediates: one pairwise rank/placement
+        # column chunk (two i32 [qt, m, chunk] temps live at the peak)
+        # and one parent block's f32 score diff
+        Resident("rank_chunk", (qt, m, 64), 4, buffers=2, kind="body"),
+        Resident("score_blk", (width * deg, d), 4, kind="body"),
+    ]
+    return KernelResidency("cagra_search._beam_kernel", tuple(residents))
+
+
 def ivf_scan_residency(
     *,
     m: int,

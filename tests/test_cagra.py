@@ -307,3 +307,80 @@ def test_plan_latency_search_works(rng=None):
     _, gi = brute_force.search(bf, Q, 5)
     rec = float(neighborhood_recall(np.asarray(i), np.asarray(gi)))
     assert rec >= 0.8, rec
+
+
+class TestFusedSearch:
+    """The Pallas beam kernel (mode="fused") against the XLA oracle —
+    same graph, same seeded beam, so with a float32 table the two paths
+    must agree (interpret mode on CPU)."""
+
+    def test_fused_matches_xla_parity(self, rng, nn_index):
+        X, index = nn_index
+        Q = _data(rng, 48, 16)
+        k = 10
+        sp = CagraSearchParams(
+            itopk_size=64, search_width=4, dedup="post", fused_table_dtype="float32"
+        )
+        assert cagra.fused_eligible(index, sp)
+        vx, ix = cagra.search(index, Q, k, sp, mode="xla")
+        vf, fi = cagra.search(index, Q, k, sp, mode="fused")
+        # identical top-1 and (with a bit-faithful f32 table) identical
+        # top-k: the kernel's rank merge reproduces select_k's stable
+        # tie-breaking
+        np.testing.assert_array_equal(np.asarray(ix)[:, 0], np.asarray(fi)[:, 0])
+        rec = float(neighborhood_recall(np.asarray(fi), np.asarray(ix)))
+        assert rec >= 0.99, f"fused-vs-xla agreement {rec}"
+        np.testing.assert_allclose(np.asarray(vf), np.asarray(vx), rtol=1e-5, atol=1e-5)
+
+    def test_fused_bf16_table_recall(self, rng, nn_index):
+        """The default bf16 table trades score precision for half the
+        DMA bytes — recall vs the XLA oracle stays within epsilon."""
+        X, index = nn_index
+        Q = _data(rng, 48, 16)
+        k = 10
+        sp = CagraSearchParams(itopk_size=64, search_width=4, dedup="post")
+        vx, ix = cagra.search(index, Q, k, sp, mode="xla")
+        _, fi = cagra.search(index, Q, k, sp, mode="fused")
+        rec = float(neighborhood_recall(np.asarray(fi), np.asarray(ix)))
+        assert rec >= 0.95, f"bf16 fused-vs-xla agreement {rec}"
+
+    def test_fused_batch1_smoke(self, rng, nn_index):
+        X, index = nn_index
+        q = _data(rng, 1, 16)
+        k = 10
+        sp = cagra.plan_search_params(1, k, index.size, CagraSearchParams(dedup="post"))
+        v, i = cagra.search(index, q, k, sp, mode="fused")
+        assert v.shape == (1, k) and i.shape == (1, k)
+        ids = np.asarray(i)[0]
+        assert ((ids >= 0) & (ids < index.size)).all()
+        assert len(set(ids.tolist())) == k  # dedup'd
+        vals = np.asarray(v)[0]
+        assert (np.diff(vals) >= 0).all()  # best-first
+        # agrees with the exact nearest neighbor
+        _, ref = brute_force.search(brute_force.build(X), q, 1)
+        assert ids[0] == int(np.asarray(ref)[0, 0])
+
+    def test_fused_requires_eligibility(self, rng, nn_index):
+        X, index = nn_index
+        Q = _data(rng, 8, 16)
+        with pytest.raises(Exception, match="fused mode needs"):
+            cagra.search(
+                index, Q, 10, CagraSearchParams(dedup="sort"), mode="fused"
+            )
+
+    def test_vmem_model_matches_kernel_scratch_shapes(self):
+        import jax.numpy as jnp
+
+        from raft_tpu.ops.pallas import cagra_search, vmem_model
+
+        res = vmem_model.cagra_search_residency()
+        budget = vmem_model.VMEM_HEADROOM * 16 * 2**20
+        assert res.total_bytes <= budget, res.table()
+        # the float32 parity table also fits
+        assert vmem_model.cagra_search_residency(table_itemsize=4).total_bytes <= budget
+        decls = cagra_search.kernel_scratch_shapes(32, 8, 16, 128, jnp.bfloat16)
+        scratch = [r for r in res.residents if r.kind == "scratch"]
+        assert len(scratch) == len(decls)
+        for r, decl in zip(scratch, decls):
+            assert tuple(decl.shape) == r.shape, r.name
+            assert jnp.dtype(decl.dtype).itemsize == r.itemsize, r.name

@@ -99,11 +99,15 @@ class TestIvfPqSearch:
         Q = _clustered(rng, nq, d)
         index = ivf_pq.build(X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=32, pq_dim=8, seed=3))
         _, ref_i = _exact(X, Q, k)
-        # over-fetch 4x then exact re-rank (the reference's refine pattern)
-        _, cand = ivf_pq.search(index, Q, 4 * k, IvfPqSearchParams(n_probes=32))
-        _, ann_i = refine(X, Q, cand, k, metric=DistanceType.L2Expanded)
+        # integrated refine: search(dataset=) over-fetches k * refine_ratio
+        # (default 8x) and exact re-ranks — the out-of-box Pareto config
+        _, ann_i = ivf_pq.search(index, Q, k, IvfPqSearchParams(n_probes=32), dataset=X)
         recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
         assert recall >= 0.95, f"refined recall {recall}"
+        # the standalone refine entry point agrees with the integrated path
+        _, cand = ivf_pq.search(index, Q, 8 * k, IvfPqSearchParams(n_probes=32))
+        _, man_i = refine(X, Q, cand, k, metric=DistanceType.L2Expanded)
+        assert np.array_equal(np.asarray(man_i), np.asarray(ann_i))
 
     def test_inner_product(self, rng):
         n, d, nq, k = 4000, 32, 32, 10
@@ -114,9 +118,14 @@ class TestIvfPqSearch:
             X, IvfPqIndexParams(kmeans_n_iters=5, n_lists=16, pq_dim=16, metric=DistanceType.InnerProduct, seed=4)
         )
         _, ref_i = _exact(X, Q, k, metric=DistanceType.InnerProduct)
+        # raw ADC ordering sanity (default auto->nibble codes blur a bit
+        # more than kmeans-256; the refine default recovers it below)
         _, ann_i = ivf_pq.search(index, Q, k, IvfPqSearchParams(n_probes=12))
         recall = float(neighborhood_recall(np.asarray(ann_i), np.asarray(ref_i)))
-        assert recall >= 0.75, f"IP recall {recall}"
+        assert recall >= 0.6, f"IP recall {recall}"
+        _, ref_i8 = ivf_pq.search(index, Q, k, IvfPqSearchParams(n_probes=12), dataset=X)
+        recall8 = float(neighborhood_recall(np.asarray(ref_i8), np.asarray(ref_i)))
+        assert recall8 >= 0.9, f"refined IP recall {recall8}"
 
     def test_l2sqrt_matches_l2_ranking(self, rng):
         n, d, nq, k = 2000, 16, 16, 5

@@ -55,6 +55,9 @@ KERNEL_SHAPE_BINDINGS: Dict[str, Dict[str, object]] = {
         bpr=32, banks=8,
     ),
     "ivf_scan": dict(qt=128, k=10, d=128, m=1152, w=1024),
+    # the fused CAGRA beam kernel at the 1M-row bench shape
+    # (vmem_model.cagra_search_residency defaults)
+    "cagra_search": dict(qt=32, itopk=160, width=8, deg=16, d=128),
     # tools/micro_layout.py — the layout microbench kernel
     "micro_layout": dict(QT=128, D=128, M=8704, block=(1, 8704, 128)),
 }
