@@ -43,6 +43,7 @@ Everything (data gen, builds, searches) runs on-device; only [nq, k]
 results and scalars cross the host link (which on tethered dev TPUs is
 ~2 MB/s — the round-2 bench lost minutes to transfers).
 """
+import contextlib
 import dataclasses
 import json
 import os
@@ -60,6 +61,8 @@ import jax.numpy as jnp
 jax.config.update("jax_compilation_cache_dir", os.path.expanduser("~/.cache/jax_comp"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
+from raft_tpu import obs  # noqa: E402 — needs the jax config above in place
+
 N, D, NQ, K = 1_000_000, 128, 1024, 10
 N_CENTERS = 1000
 if os.environ.get("RAFT_TPU_BENCH_SMOKE"):  # tiny code-path check (CI/CPU)
@@ -73,24 +76,75 @@ METRIC = "ann_best_qps_at_recall95_sift1m_synth_b1024_k10"
 _CHILD_ENV = "_RAFT_TPU_BENCH_CHILD"
 
 
-def _timed(fn, nrep=2, inner=4):
+def _timed(fn, nrep=2, inner=4, label=None):
     """Min wall-clock per call over ``inner`` pipelined calls per sync.
 
     Dispatches are async; issuing ``inner`` searches before one scalar
     fetch measures sustained pipelined throughput and amortizes the
     host-link round trip (~100-300 ms on tunneled dev TPUs — larger than
     most searches). Sync is a scalar fetch because block_until_ready
-    no-ops through the tunnel."""
-    out = fn()
-    float(jnp.sum(out[0]))  # warm + sync
-    best = float("inf")
-    for _ in range(max(1, nrep)):
-        t0 = time.perf_counter()
-        for _ in range(inner):
-            out = fn()
-        float(jnp.sum(out[0]))
-        best = min(best, (time.perf_counter() - t0) / inner)
+    no-ops through the tunnel.
+
+    With obs enabled and a ``label``, the measurement region becomes a
+    ``bench.<label>`` span and the per-call result lands in the
+    ``bench.timed_ms`` histogram."""
+    scope = obs.span(f"bench.{label}", nrep=nrep, inner=inner) if label else contextlib.nullcontext()
+    with scope:
+        out = fn()
+        float(jnp.sum(out[0]))  # warm + sync
+        best = float("inf")
+        for _ in range(max(1, nrep)):
+            t0 = time.perf_counter()
+            for _ in range(inner):
+                out = fn()
+            float(jnp.sum(out[0]))
+            best = min(best, (time.perf_counter() - t0) / inner)
+    if label and obs.is_enabled():
+        obs.observe("bench.timed_ms", best * 1e3, label=label)
     return best, out
+
+
+@contextlib.contextmanager
+def _build_phase(build_times, name):
+    """Time an index-build block (body must device-sync before exit, as
+    every call site does with a scalar fetch) into ``build_times[name]``
+    and, when obs is on, a ``bench.build.<name>`` span."""
+    with obs.span(f"bench.build.{name}"):
+        t0 = time.perf_counter()
+        yield
+        build_times[name] = round(time.perf_counter() - t0, 1)
+
+
+def compute_efficiency(ops, hw, exact_tflops):
+    """Kernel quality separated from tenancy (VERDICT r4 #9): achieved
+    exact-search TFLOP/s against the matmul peak measured moments earlier
+    on the SAME (time-shared) chip, and fused-scan streaming estimates
+    against the measured copy bandwidth. Fractions are > 0 and — with the
+    device-resident delta-timed probes of ``_hw_context`` — must come out
+    <= ~1; a fraction past 1 means the probe (not the kernel) is lying,
+    which is exactly what ``tests/test_bench_export.py`` pins down."""
+    efficiency = {
+        "exact_achieved_tflops": round(exact_tflops, 2),
+        "mfu_vs_measured_peak": (
+            round(exact_tflops / hw["bf16_matmul_tflops"], 3)
+            if hw["bf16_matmul_tflops"] > 0 else None
+        ),
+    }
+    flat_best = ops.get("ivf_flat")
+    if flat_best and "stream_gbps_est" in flat_best:
+        efficiency["fused_stream_gbps_est"] = flat_best["stream_gbps_est"]
+        efficiency["fused_frac_of_measured_copy_bw"] = (
+            round(flat_best["stream_gbps_est"] / hw["hbm_copy_gbps"], 3)
+            if hw["hbm_copy_gbps"] > 0 else None
+        )
+    cf_best = ops.get("cagra_fused")
+    if cf_best and "stream_gbps_est" in cf_best:
+        efficiency["cagra_fused_stream_gbps_est"] = cf_best["stream_gbps_est"]
+        efficiency["cagra_fused_frac_of_measured_copy_bw"] = (
+            round(cf_best["stream_gbps_est"] / hw["hbm_copy_gbps"], 3)
+            if hw["hbm_copy_gbps"] > 0 else None
+        )
+    return efficiency
 
 
 def _hw_context():
@@ -403,6 +457,12 @@ def _bench_main():
     threading.Thread(
         target=_watchdog, args=(_results_for_watchdog, _done, hard_s, t_all), daemon=True
     ).start()
+    # Observability is ON by default for bench runs (RAFT_TPU_OBS=0 opts
+    # out): the instrumented search/build layers feed the span registry
+    # that becomes bench_artifacts/{metrics.jsonl,trace.json} below.
+    if os.environ.get("RAFT_TPU_OBS", "1").strip().lower() not in ("0", "false", "off", "no"):
+        obs.enable()
+        obs.registry().reset()
     hw = _hw_context()
     print(f"# hw: copy {hw['hbm_copy_gbps']} GB/s, bf16 {hw['bf16_matmul_tflops']} TFLOP/s", flush=True)
     dataset, queries, source = _load_data()
@@ -415,6 +475,7 @@ def _bench_main():
     t_exact, (ev, ei) = _timed(
         lambda: brute_force.search(bf, queries, K, query_batch=nq, dataset_tile=262144),
         nrep=2,
+        label="brute_force_exact",
     )
     gt = np.asarray(ei)
 
@@ -477,7 +538,9 @@ def _bench_main():
     record("brute_force_exact", "tile=262144", t_exact, ei,
            achieved_tflops=round(exact_tflops, 2))
 
-    dt, (v, i) = _timed(lambda: brute_force.search(bf, queries, K, mode="approx"))
+    dt, (v, i) = _timed(
+        lambda: brute_force.search(bf, queries, K, mode="approx"), label="brute_force_approx"
+    )
     record("brute_force", "approx rt=0.99", dt, i)
 
     # ---- IVF-Flat: fused Pallas scan, bf16 lists, bank merge -------------
@@ -488,16 +551,15 @@ def _bench_main():
     phase_errors = {}
     try:
         n_lists_flat = 1024
-        t0 = time.perf_counter()
-        fidx = ivf_flat.build(
-            dataset,
-            ivf_flat.IvfFlatIndexParams(
-                n_lists=n_lists_flat, kmeans_n_iters=10, kmeans_trainset_fraction=0.1,
-                list_cap_factor=1.1,
-            ),
-        )
-        float(jnp.sum(fidx.list_sizes))
-        build_times["ivf_flat"] = round(time.perf_counter() - t0, 1)
+        with _build_phase(build_times, "ivf_flat"):
+            fidx = ivf_flat.build(
+                dataset,
+                ivf_flat.IvfFlatIndexParams(
+                    n_lists=n_lists_flat, kmeans_n_iters=10, kmeans_trainset_fraction=0.1,
+                    list_cap_factor=1.1,
+                ),
+            )
+            float(jnp.sum(fidx.list_sizes))
         bf16_idx = dataclasses.replace(fidx, list_data=fidx.list_data.astype(jnp.bfloat16))
         flat_kw = dict(fused_qt=128, fused_probe_factor=32, fused_merge="bank8",
                        fused_precision="default", fused_col_chunk=1024)
@@ -551,20 +613,22 @@ def _bench_main():
         print("# ivf_pq skipped: time budget", flush=True)
     else:
         try:
-            t0 = time.perf_counter()
-            pidx = ivf_pq.build(
-                dataset,
-                ivf_pq.IvfPqIndexParams(
-                    n_lists=1024, pq_dim=32, pq_bits=8, pq_kind="nibble",
-                    kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
-                ),
-            )
-            float(jnp.sum(pidx.list_sizes))
-            build_times["ivf_pq"] = round(time.perf_counter() - t0, 1)
+            with _build_phase(build_times, "ivf_pq"):
+                pidx = ivf_pq.build(
+                    dataset,
+                    ivf_pq.IvfPqIndexParams(
+                        n_lists=1024, pq_dim=32, pq_bits=8, pq_kind="nibble",
+                        kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
+                    ),
+                )
+                float(jnp.sum(pidx.list_sizes))
             code_mb = round(pidx.codes.size / 1e6, 1)
 
             sp30 = ivf_pq.IvfPqSearchParams(n_probes=30, fused_probe_factor=32, fused_group=8)
-            dt, (v, i) = _timed(lambda: ivf_pq.search(pidx, queries, K, sp30, mode="fused"), nrep=2)
+            dt, (v, i) = _timed(
+                lambda: ivf_pq.search(pidx, queries, K, sp30, mode="fused"),
+                nrep=2, label="ivf_pq_fused_npr30",
+            )
             record("ivf_pq", f"fused nib32 npr=30 ({code_mb}MB codes)", dt, i)
 
             def pq_refined(sp, rr):
@@ -587,16 +651,15 @@ def _bench_main():
             # pq_dim=64 (2-dim subspaces): ~2x decode FLOPs and code bytes
             # for a much higher ADC base recall, so a shallow 4x refine
             # reaches the operating point
-            t0 = time.perf_counter()
-            pidx64 = ivf_pq.build(
-                dataset,
-                ivf_pq.IvfPqIndexParams(
-                    n_lists=1024, pq_dim=64, pq_bits=8, pq_kind="nibble",
-                    kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
-                ),
-            )
-            float(jnp.sum(pidx64.list_sizes))
-            build_times["ivf_pq_dim64"] = round(time.perf_counter() - t0, 1)
+            with _build_phase(build_times, "ivf_pq_dim64"):
+                pidx64 = ivf_pq.build(
+                    dataset,
+                    ivf_pq.IvfPqIndexParams(
+                        n_lists=1024, pq_dim=64, pq_bits=8, pq_kind="nibble",
+                        kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
+                    ),
+                )
+                float(jnp.sum(pidx64.list_sizes))
             code64_mb = round(pidx64.codes.size / 1e6, 1)
             sp64 = ivf_pq.IvfPqSearchParams(n_probes=30, fused_probe_factor=32, fused_group=8)
             dt, (v, i) = _timed(
@@ -618,16 +681,15 @@ def _bench_main():
             # what a user gets with zero tuning (the r5 verdict's 4.6k @
             # 0.56 kmeans-256 default is gone).
             if not over_budget(0.55):
-                t0 = time.perf_counter()
-                pidx_def = ivf_pq.build(
-                    dataset,
-                    ivf_pq.IvfPqIndexParams(
-                        n_lists=1024, pq_dim=32,
-                        kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
-                    ),
-                )
-                float(jnp.sum(pidx_def.list_sizes))
-                build_times["ivf_pq_default"] = round(time.perf_counter() - t0, 1)
+                with _build_phase(build_times, "ivf_pq_default"):
+                    pidx_def = ivf_pq.build(
+                        dataset,
+                        ivf_pq.IvfPqIndexParams(
+                            n_lists=1024, pq_dim=32,
+                            kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
+                        ),
+                    )
+                    float(jnp.sum(pidx_def.list_sizes))
                 dt, (v, i) = _timed(
                     lambda: ivf_pq.search(
                         pidx_def, queries, K, mode="fused", dataset=dataset
@@ -640,16 +702,15 @@ def _bench_main():
             # fused decode — proof the reference's 8-bit layout is still
             # work-proportional (VERDICT r4 item 3), not the dense scan
             if not over_budget(0.55):
-                t0 = time.perf_counter()
-                pidx256 = ivf_pq.build(
-                    dataset,
-                    ivf_pq.IvfPqIndexParams(
-                        n_lists=1024, pq_dim=32, pq_bits=8, pq_kind="kmeans",
-                        kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
-                    ),
-                )
-                float(jnp.sum(pidx256.list_sizes))
-                build_times["ivf_pq_kmeans256"] = round(time.perf_counter() - t0, 1)
+                with _build_phase(build_times, "ivf_pq_kmeans256"):
+                    pidx256 = ivf_pq.build(
+                        dataset,
+                        ivf_pq.IvfPqIndexParams(
+                            n_lists=1024, pq_dim=32, pq_bits=8, pq_kind="kmeans",
+                            kmeans_n_iters=10, kmeans_trainset_fraction=0.1, list_cap_factor=1.1,
+                        ),
+                    )
+                    float(jnp.sum(pidx256.list_sizes))
                 sp256 = ivf_pq.IvfPqSearchParams(n_probes=30, fused_probe_factor=32, fused_group=8)
                 dt, (v, i) = _timed(
                     lambda: ivf_pq.search(pidx256, queries, K, sp256, mode="fused"), nrep=2
@@ -671,16 +732,15 @@ def _bench_main():
     try:
         if cagra_err:
             raise TimeoutError(cagra_err)
-        t0 = time.perf_counter()
-        cidx = cagra.build(
-            dataset,
-            cagra.CagraIndexParams(
-                intermediate_graph_degree=32, graph_degree=16, build_algo=cagra.IVF_PQ
-            ),
-            pq_index=pidx,
-        )
-        float(jnp.sum(cidx.graph[0].astype(jnp.float32)))
-        build_times["cagra"] = round(time.perf_counter() - t0, 1)
+        with _build_phase(build_times, "cagra"):
+            cidx = cagra.build(
+                dataset,
+                cagra.CagraIndexParams(
+                    intermediate_graph_degree=32, graph_degree=16, build_algo=cagra.IVF_PQ
+                ),
+                pq_index=pidx,
+            )
+            float(jnp.sum(cidx.graph[0].astype(jnp.float32)))
         # width 8: measured dominant over width 4 at equal itopk/recall
         # (artifacts/tpu/cagra_width_sweep_*) — iterations drop ~2x while
         # per-iteration fixed costs stay flat
@@ -712,10 +772,9 @@ def _bench_main():
         if jax.default_backend() == "tpu" and not over_budget(0.85):
             sp_f = cagra.CagraSearchParams(dedup="post")
             if cagra.fused_eligible(cidx, sp_f):
-                t0 = time.perf_counter()
-                ftbl = cagra._fused_table(cidx, sp_f.fused_table_dtype)
-                float(jnp.sum(ftbl[0].astype(jnp.float32)))
-                build_times["cagra_fused_table"] = round(time.perf_counter() - t0, 1)
+                with _build_phase(build_times, "cagra_fused_table"):
+                    ftbl = cagra._fused_table(cidx, sp_f.fused_table_dtype)
+                    float(jnp.sum(ftbl[0].astype(jnp.float32)))
                 for itopk, w in ((96, 8), (128, 8), (160, 8)):
                     sp_f = cagra.CagraSearchParams(
                         itopk_size=itopk, search_width=w, dedup="post"
@@ -800,28 +859,7 @@ def _bench_main():
     reached = {a: r for a, r in ops.items() if r is not None}
     best_algo, best = max(reached.items(), key=lambda kv: kv[1]["qps"])
 
-    # efficiency: kernel quality separated from tenancy (VERDICT r4 #9)
-    efficiency = {
-        "exact_achieved_tflops": round(exact_tflops, 2),
-        "mfu_vs_measured_peak": (
-            round(exact_tflops / hw["bf16_matmul_tflops"], 3)
-            if hw["bf16_matmul_tflops"] > 0 else None
-        ),
-    }
-    flat_best = ops.get("ivf_flat")
-    if flat_best and "stream_gbps_est" in flat_best:
-        efficiency["fused_stream_gbps_est"] = flat_best["stream_gbps_est"]
-        efficiency["fused_frac_of_measured_copy_bw"] = (
-            round(flat_best["stream_gbps_est"] / hw["hbm_copy_gbps"], 3)
-            if hw["hbm_copy_gbps"] > 0 else None
-        )
-    cf_best = ops.get("cagra_fused")
-    if cf_best and "stream_gbps_est" in cf_best:
-        efficiency["cagra_fused_stream_gbps_est"] = cf_best["stream_gbps_est"]
-        efficiency["cagra_fused_frac_of_measured_copy_bw"] = (
-            round(cf_best["stream_gbps_est"] / hw["hbm_copy_gbps"], 3)
-            if hw["hbm_copy_gbps"] > 0 else None
-        )
+    efficiency = compute_efficiency(ops, hw, exact_tflops)
 
     if _rec is not None:
         try:
@@ -865,6 +903,21 @@ def _bench_main():
         artifacts["plot"] = plot_report(bench_doc, "bench_artifacts/results.png")
     except Exception as e:  # noqa: BLE001
         artifacts["error"] = f"{type(e).__name__}: {e}"[:200]
+
+    if obs.is_enabled():
+        # metrics snapshot + Perfetto-openable trace of the whole run; the
+        # report CLI prints the same summary a user would get offline via
+        # `python tools/obs_report.py bench_artifacts/metrics.jsonl`.
+        try:
+            os.makedirs("bench_artifacts", exist_ok=True)
+            artifacts["metrics"] = obs.write_metrics_jsonl("bench_artifacts/metrics.jsonl")
+            artifacts["trace"] = obs.write_trace("bench_artifacts/trace.json")
+            sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+            from obs_report import render_report
+
+            print(render_report(artifacts["metrics"], artifacts["trace"]), flush=True)
+        except Exception as e:  # noqa: BLE001
+            artifacts["obs_error"] = f"{type(e).__name__}: {e}"[:200]
 
     _done.set()
     print(

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core.errors import expects
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import DistanceType, resolve_metric
@@ -137,20 +138,31 @@ def fit(
 
     min_close = is_min_close(metric)
 
+    if obs.is_enabled():
+        obs.inc("kmeans.fit.calls", init=str(params.init if centroids is None else "array"))
+        obs.inc("kmeans.fit.samples", float(n))
+
     key = as_key(params.seed)
     best = None
     for trial in range(max(1, params.n_init)):
         key, kinit = jax.random.split(key)
-        if centroids is not None:
-            init_centers = jnp.asarray(centroids, jnp.float32)
-            expects(init_centers.shape == (k, d), "explicit centroids shape mismatch")
-        elif params.init == "random":
-            idx = jax.random.permutation(kinit, n)[:k]
-            init_centers = X[idx]
-        else:
-            init_centers = kmeans_plus_plus(kinit, X, k, sample_weights)
+        with obs.span("kmeans.fit.init", k=k, n=n, trial=trial) as sp:
+            if centroids is not None:
+                init_centers = jnp.asarray(centroids, jnp.float32)
+                expects(init_centers.shape == (k, d), "explicit centroids shape mismatch")
+            elif params.init == "random":
+                idx = jax.random.permutation(kinit, n)[:k]
+                init_centers = X[idx]
+            else:
+                init_centers = kmeans_plus_plus(kinit, X, k, sample_weights)
+            sp.sync(init_centers)
 
-        out = _lloyd(X, init_centers, k, metric, params.max_iter, params.tol, weights)
+        with obs.span("kmeans.fit.lloyd", k=k, n=n, trial=trial) as sp:
+            out = sp.sync(
+                _lloyd(X, init_centers, k, metric, params.max_iter, params.tol, weights)
+            )
+        if obs.is_enabled():
+            obs.observe("kmeans.fit.n_iter", float(out.n_iter))
         better = best is None or (
             float(out.inertia) < float(best.inertia)
             if min_close

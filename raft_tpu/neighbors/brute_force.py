@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core import serialize as ser
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.errors import expects
@@ -235,7 +236,33 @@ def search(
     :func:`raft_tpu.ops.select_k.approx_select_k`) — orders of magnitude
     faster on large n, returning each true neighbor with probability
     ``recall_target``; available for the expanded metrics
-    (L2/IP/cosine)."""
+    (L2/IP/cosine).
+
+    With :mod:`raft_tpu.obs` enabled the call is wrapped in a
+    device-synced ``brute_force.search`` span with per-mode counters."""
+    if not obs.is_enabled():
+        return _search_dispatch(
+            index, queries, k, prefilter, query_batch, dataset_tile, mode, recall_target, res
+        )
+    with obs.span("brute_force.search", k=k, nq=int(np.shape(queries)[0]), mode=mode) as sp:
+        return sp.sync(
+            _search_dispatch(
+                index, queries, k, prefilter, query_batch, dataset_tile, mode, recall_target, res
+            )
+        )
+
+
+def _search_dispatch(
+    index: BruteForceIndex,
+    queries,
+    k: int,
+    prefilter: Optional[Bitset],
+    query_batch: int,
+    dataset_tile: Optional[int],
+    mode: str,
+    recall_target: float,
+    res: Optional[Resources],
+) -> Tuple[jax.Array, jax.Array]:
     res = ensure_resources(res)
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2, "queries must be [n_queries, dim]")
@@ -248,6 +275,9 @@ def search(
     metric = index.metric
     select_min = is_min_close(metric)
     nq = queries.shape[0]
+    if obs.is_enabled():
+        obs.inc("brute_force.search.calls", mode=mode)
+        obs.inc("brute_force.search.queries", float(nq))
 
     if mode == "approx":
         expects(
@@ -260,17 +290,20 @@ def search(
         n_blocks = cdiv(nq, block)
         pad = n_blocks * block - nq
         qp = jnp.pad(queries, ((0, pad), (0, 0))) if pad else queries
-        v, i = _search_approx_impl(
-            index.dataset,
-            index.norms,
-            qp.reshape(n_blocks, block, index.dim),
-            filter_mask,
-            k=k,
-            metric=metric,
-            select_min=select_min,
-            has_filter=filter_mask is not None,
-            recall_target=recall_target,
-        )
+        with obs.span("brute_force.search.approx", nq=nq, k=k) as sp:
+            v, i = sp.sync(
+                _search_approx_impl(
+                    index.dataset,
+                    index.norms,
+                    qp.reshape(n_blocks, block, index.dim),
+                    filter_mask,
+                    k=k,
+                    metric=metric,
+                    select_min=select_min,
+                    has_filter=filter_mask is not None,
+                    recall_target=recall_target,
+                )
+            )
         v = v.reshape(n_blocks * block, k)[:nq]
         i = i.reshape(n_blocks * block, k)[:nq]
         return v, i
@@ -297,18 +330,23 @@ def search(
         if qchunk.shape[0] < query_batch and nq > query_batch:
             bpad = query_batch - qchunk.shape[0]
             qchunk = jnp.pad(qchunk, ((0, bpad), (0, 0)))
-        v, i = _search_impl(
-            index.dataset,
-            index.norms,
-            qchunk,
-            filter_mask,
-            k=k,
-            metric=metric,
-            p=index.metric_arg,
-            tile=dataset_tile,
-            select_min=select_min,
-            has_filter=filter_mask is not None,
-        )
+        with obs.span(
+            "brute_force.search.exact_batch", nq=qchunk.shape[0], k=k, tile=dataset_tile
+        ) as sp:
+            v, i = sp.sync(
+                _search_impl(
+                    index.dataset,
+                    index.norms,
+                    qchunk,
+                    filter_mask,
+                    k=k,
+                    metric=metric,
+                    p=index.metric_arg,
+                    tile=dataset_tile,
+                    select_min=select_min,
+                    has_filter=filter_mask is not None,
+                )
+            )
         if bpad:
             v, i = v[:-bpad], i[:-bpad]
         out_v.append(v)

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core import serialize as ser
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.errors import expects
@@ -353,36 +354,39 @@ def build(
         from raft_tpu.core.logging import logger
 
         t0 = _time.perf_counter()
-        if pq_index is not None:
-            expects(pq_index.size == n, "pq_index covers %d rows, dataset has %d", pq_index.size, n)
-            pq = pq_index
-        else:
-            pq = ivf_pq_mod.build(
-                dataset,
-                ivf_pq_mod.IvfPqIndexParams(
-                    n_lists=max(1, min(1024, n // 128)),
-                    metric=metric,
-                    seed=params.seed,
-                    # pq_dim 32 keeps the fused decode LUT small (K = 32*32
-                    # columns); graph-build shortlists only need coarse
-                    # ranking, the exact refine below restores order
-                    pq_dim=32 if d >= 64 and d % 32 == 0 else 0,
-                    pq_kind="nibble",
-                    kmeans_n_iters=10,
-                    kmeans_trainset_fraction=min(1.0, max(0.05, 100_000 / max(n, 1))),
-                    list_cap_factor=1.1,
-                ),
-            )
-        jax.block_until_ready(pq.codes)
+        with obs.span("cagra.build.pq_build", n=n):
+            if pq_index is not None:
+                expects(pq_index.size == n, "pq_index covers %d rows, dataset has %d", pq_index.size, n)
+                pq = pq_index
+            else:
+                pq = ivf_pq_mod.build(
+                    dataset,
+                    ivf_pq_mod.IvfPqIndexParams(
+                        n_lists=max(1, min(1024, n // 128)),
+                        metric=metric,
+                        seed=params.seed,
+                        # pq_dim 32 keeps the fused decode LUT small (K = 32*32
+                        # columns); graph-build shortlists only need coarse
+                        # ranking, the exact refine below restores order
+                        pq_dim=32 if d >= 64 and d % 32 == 0 else 0,
+                        pq_kind="nibble",
+                        kmeans_n_iters=10,
+                        kmeans_trainset_fraction=min(1.0, max(0.05, 100_000 / max(n, 1))),
+                        list_cap_factor=1.1,
+                    ),
+                )
+            jax.block_until_ready(pq.codes)
         t1 = _time.perf_counter()
         top = kin + 1
-        _, cand = ivf_pq_mod.search(
-            pq, dataset, min(2 * top, pq.size), n_probes=24, query_batch=4096
-        )
-        jax.block_until_ready(cand)
+        with obs.span("cagra.build.self_search", n=n):
+            _, cand = ivf_pq_mod.search(
+                pq, dataset, min(2 * top, pq.size), n_probes=24, query_batch=4096
+            )
+            jax.block_until_ready(cand)
         t2 = _time.perf_counter()
-        _, nbrs = refine_fn(dataset, dataset, cand, top, metric=metric)
-        jax.block_until_ready(nbrs)
+        with obs.span("cagra.build.refine", n=n):
+            _, nbrs = refine_fn(dataset, dataset, cand, top, metric=metric)
+            jax.block_until_ready(nbrs)
         logger.info(
             "cagra ivf_pq graph build: pq_build %.1fs, self-search %.1fs, refine %.1fs",
             t1 - t0, t2 - t1, _time.perf_counter() - t2,
@@ -845,7 +849,37 @@ def search(
     across iterations, parents' packed neighbor rows streamed HBM->VMEM;
     ``"xla"`` = the gather/einsum/select loop (the fallback and the
     recall oracle the fused path is tested against); ``"auto"`` picks
-    fused on TPU when :func:`fused_eligible`, else xla."""
+    fused on TPU when :func:`fused_eligible`, else xla.
+
+    With observability on (:mod:`raft_tpu.obs`, ``RAFT_TPU_OBS=1``) the
+    call records a sync-aware ``cagra.search`` span with per-batch
+    children, the mode chosen (fused vs xla), iterations executed, and
+    beam occupancy; disabled (the default) it costs one flag check."""
+    if not obs.is_enabled():
+        return _search_dispatch(
+            index, queries, k, params, prefilter, query_batch, res, mode, **kwargs
+        )
+    with obs.span("cagra.search", k=k, nq=int(np.shape(queries)[0])) as sp:
+        return sp.sync(
+            _search_dispatch(
+                index, queries, k, params, prefilter, query_batch, res, mode, **kwargs
+            )
+        )
+
+
+def _search_dispatch(
+    index: CagraIndex,
+    queries,
+    k: int,
+    params: Optional[CagraSearchParams],
+    prefilter: Optional[Bitset],
+    query_batch: int,
+    res: Optional[Resources],
+    mode: str,
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mode routing + query batching behind :func:`search` (split out so
+    the observability-off path costs a single flag check)."""
     ensure_resources(res)
     if params is None:
         params = CagraSearchParams(**kwargs)
@@ -875,6 +909,12 @@ def search(
             "fused mode needs a raw dataset, init_sample > 0, dedup='post', "
             "no prefilter, and graph_degree <= dim (use mode='xla')",
         )
+    if obs.is_enabled():
+        obs.inc("cagra.search.calls", mode=mode)
+        obs.inc("cagra.search.queries", float(queries.shape[0]))
+        obs.observe("cagra.search.iterations", float(iters))
+        obs.set_gauge("cagra.search.itopk", float(itopk))
+        obs.set_gauge("cagra.search.width", float(width))
 
     nq = queries.shape[0]
     key = as_key(params.seed)
@@ -893,22 +933,31 @@ def search(
             init_ids = jax.random.randint(kb, (qc.shape[0], n_init), 0, index.size, jnp.int32)
         if mode == "fused":
             table = _fused_table(index, params.fused_table_dtype)
-            v, i = _cagra_fused_impl(
-                table,
-                index.dataset,
-                index.sqnorms,
-                qc,
-                init_ids,
-                k=k,
-                itopk=itopk,
-                width=width,
-                iters=iters,
-                metric=index.metric,
-                qt=max(8, min(params.fused_qt, -(-qc.shape[0] // 8) * 8)),
-                interpret=jax.default_backend() != "tpu",
-            )
+            with obs.span(
+                "cagra.search.fused_batch", nq=qc.shape[0], iters=iters, width=width
+            ) as sp:
+                v, i = sp.sync(
+                    _cagra_fused_impl(
+                        table,
+                        index.dataset,
+                        index.sqnorms,
+                        qc,
+                        init_ids,
+                        k=k,
+                        itopk=itopk,
+                        width=width,
+                        iters=iters,
+                        metric=index.metric,
+                        qt=max(8, min(params.fused_qt, -(-qc.shape[0] // 8) * 8)),
+                        interpret=jax.default_backend() != "tpu",
+                    )
+                )
             if bpad:
                 v, i = v[:-bpad], i[:-bpad]
+            if obs.is_enabled():
+                obs.observe(
+                    "cagra.search.beam_occupancy", float(jnp.mean(i >= 0)), mode="fused"
+                )
             out_v.append(v)
             out_i.append(i)
             continue
@@ -924,25 +973,34 @@ def search(
                 index.vpq.codes,
             )
             sqnorms = index.vpq.sqnorms
-        v, i = _cagra_search_impl(
-            index.dataset,
-            sqnorms,
-            index.graph,
-            qc,
-            init_ids,
-            filter_bits,
-            vpq_arrays,
-            k=k,
-            itopk=itopk,
-            width=width,
-            iters=iters,
-            metric=index.metric,
-            has_filter=filter_bits is not None,
-            use_vpq=use_vpq,
-            dedup={True: "sort", False: "none"}.get(params.dedup, params.dedup),
-        )
+        with obs.span(
+            "cagra.search.xla_batch", nq=qc.shape[0], iters=iters, width=width
+        ) as sp:
+            v, i = sp.sync(
+                _cagra_search_impl(
+                    index.dataset,
+                    sqnorms,
+                    index.graph,
+                    qc,
+                    init_ids,
+                    filter_bits,
+                    vpq_arrays,
+                    k=k,
+                    itopk=itopk,
+                    width=width,
+                    iters=iters,
+                    metric=index.metric,
+                    has_filter=filter_bits is not None,
+                    use_vpq=use_vpq,
+                    dedup={True: "sort", False: "none"}.get(params.dedup, params.dedup),
+                )
+            )
         if bpad:
             v, i = v[:-bpad], i[:-bpad]
+        if obs.is_enabled():
+            obs.observe(
+                "cagra.search.beam_occupancy", float(jnp.mean(i >= 0)), mode="xla"
+            )
         out_v.append(v)
         out_i.append(i)
     if len(out_v) == 1:

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import BalancedKMeansParams
 from raft_tpu.core import serialize as ser
@@ -814,28 +815,33 @@ def _ivf_pq_scan_impl(
     nq, d = queries.shape
     qf = queries.astype(jnp.float32)
 
-    # coarse scores double as the probe selector AND the q.c_l term
-    q_dot_c = qf @ centers.T  # [nq, n_lists]
-    if metric == DistanceType.InnerProduct:
-        coarse = -q_dot_c
-    else:
-        c_norm = jnp.sum(centers * centers, axis=1)
-        coarse = c_norm[None, :] - 2.0 * q_dot_c
-    n_lists = centers.shape[0]
-    probed = jnp.zeros((nq, n_lists), bool)
-    if n_probes < n_lists:
-        _, probes = select_k(coarse, n_probes, select_min=True)
-        probed = probed.at[jnp.arange(nq)[:, None], probes].set(True)
-    else:
-        probed = jnp.ones((nq, n_lists), bool)
+    with obs.span("ivf_pq.search.coarse_probe", nq=nq, n_probes=n_probes) as sp:
+        # coarse scores double as the probe selector AND the q.c_l term
+        q_dot_c = qf @ centers.T  # [nq, n_lists]
+        if metric == DistanceType.InnerProduct:
+            coarse = -q_dot_c
+        else:
+            c_norm = jnp.sum(centers * centers, axis=1)
+            coarse = c_norm[None, :] - 2.0 * q_dot_c
+        n_lists = centers.shape[0]
+        probed = jnp.zeros((nq, n_lists), bool)
+        if n_probes < n_lists:
+            _, probes = select_k(coarse, n_probes, select_min=True)
+            probed = probed.at[jnp.arange(nq)[:, None], probes].set(True)
+        else:
+            probed = jnp.ones((nq, n_lists), bool)
+        sp.sync(probed)
 
     q_rot = qf @ rotation.T  # [nq, rot_dim]
-    return pq_scan_core(
-        pq_centers, codes, list_indices, rot_sqnorms, q_rot, q_dot_c,
-        probed, filter_bits,
-        k=k, metric=metric, per_cluster=per_cluster, has_filter=has_filter,
-        chunk_lists=chunk_lists, bf16=bf16,
-    )
+    with obs.span("ivf_pq.search.pq_scan", nq=nq, k=k) as sp:
+        return sp.sync(
+            pq_scan_core(
+                pq_centers, codes, list_indices, rot_sqnorms, q_rot, q_dot_c,
+                probed, filter_bits,
+                k=k, metric=metric, per_cluster=per_cluster, has_filter=has_filter,
+                chunk_lists=chunk_lists, bf16=bf16,
+            )
+        )
 
 
 @functools.partial(
@@ -1135,7 +1141,40 @@ def search(
     deterministic probe path); ``"probe"`` = per-probe LUT gather (the
     literal analog of the reference's kernel schedule; better for
     single-digit query batches); ``"auto"`` picks fused on TPU when
-    eligible for batches >= 128, else scan/probe by batch size."""
+    eligible for batches >= 128, else scan/probe by batch size.
+
+    With observability on (:mod:`raft_tpu.obs`, ``RAFT_TPU_OBS=1``) the
+    call records a sync-aware ``ivf_pq.search`` span with per-phase
+    children (``coarse_probe`` / ``pq_scan`` / ``probe_scan`` /
+    ``fused`` / ``refine``) plus counters for mode, n_probes, LUT dtype
+    and refine candidates; disabled (the default) it costs one flag
+    check."""
+    if not obs.is_enabled():
+        return _search_dispatch(
+            index, queries, k, params, prefilter, query_batch, mode, res, dataset, **kwargs
+        )
+    with obs.span("ivf_pq.search", k=k, nq=int(np.shape(queries)[0])) as sp:
+        return sp.sync(
+            _search_dispatch(
+                index, queries, k, params, prefilter, query_batch, mode, res, dataset, **kwargs
+            )
+        )
+
+
+def _search_dispatch(
+    index: IvfPqIndex,
+    queries,
+    k: int,
+    params: Optional[IvfPqSearchParams],
+    prefilter: Optional[Bitset],
+    query_batch: int,
+    mode: str,
+    res: Optional[Resources],
+    dataset,
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mode routing + query batching behind :func:`search` (split out so
+    the observability-off path costs a single flag check)."""
     ensure_resources(res)
     if params is None:
         params = IvfPqSearchParams(**kwargs)
@@ -1151,7 +1190,12 @@ def search(
             index, queries, kk, inner,
             prefilter=prefilter, query_batch=query_batch, mode=mode, res=res,
         )
-        return refine(dataset, queries, cand, k, metric=resolve_metric(index.metric))
+        if obs.is_enabled():
+            obs.observe("ivf_pq.search.refine_candidates_per_query", float(kk))
+        with obs.span("ivf_pq.search.refine", k=k, candidates=int(kk)) as sp:
+            return sp.sync(
+                refine(dataset, queries, cand, k, metric=resolve_metric(index.metric))
+            )
     if prefilter is not None:
         expects(prefilter.size >= index.size, "prefilter smaller than index")
     n_probes = min(params.n_probes, index.n_lists)
@@ -1193,6 +1237,11 @@ def search(
     expects(
         mode in ("scan", "probe", "fused"), "mode must be auto|scan|probe|fused, got %r", mode
     )
+    if obs.is_enabled():
+        lut = jnp.dtype(params.lut_dtype).name if params.lut_dtype is not None else "default"
+        obs.inc("ivf_pq.search.calls", mode=mode, lut=lut)
+        obs.inc("ivf_pq.search.queries", float(nq))
+        obs.observe("ivf_pq.search.n_probes", float(n_probes))
 
     if mode == "fused":
         from raft_tpu.ops.pallas.pq_scan import ivf_pq_fused_search, vmem_decode_cols
@@ -1280,7 +1329,8 @@ def search(
 
         from raft_tpu.neighbors.ivf_flat import _batched_search
 
-        return _batched_search(run_fused, queries, query_batch)
+        with obs.span("ivf_pq.search.fused", nq=nq, k=k, n_probes=n_probes) as sp:
+            return sp.sync(_batched_search(run_fused, queries, query_batch))
 
     if mode == "scan":
         g = scan_chunk_lists(index.n_lists, index.max_list)
@@ -1333,22 +1383,27 @@ def search(
         if qc.shape[0] < query_batch and nq > query_batch:
             bpad = query_batch - qc.shape[0]
             qc = jnp.pad(qc, ((0, bpad), (0, 0)))
-        v, i = _ivf_pq_search_impl(
-            index.centers,
-            index.centers_rot,
-            index.rotation,
-            index.pq_centers,
-            codes_u,
-            index.list_indices,
-            qc,
-            filter_bits,
-            k=k,
-            n_probes=n_probes,
-            metric=index.metric,
-            per_cluster=index.codebook_kind == PER_CLUSTER,
-            has_filter=filter_bits is not None,
-            lut_dtype=jnp.dtype(params.lut_dtype or jnp.float32).name,
-        )
+        # the per-probe LUT gather fuses coarse probing and the scan in one
+        # jitted program — the span covers both phases
+        with obs.span("ivf_pq.search.probe_scan", nq=qc.shape[0], k=k) as sp:
+            v, i = sp.sync(
+                _ivf_pq_search_impl(
+                    index.centers,
+                    index.centers_rot,
+                    index.rotation,
+                    index.pq_centers,
+                    codes_u,
+                    index.list_indices,
+                    qc,
+                    filter_bits,
+                    k=k,
+                    n_probes=n_probes,
+                    metric=index.metric,
+                    per_cluster=index.codebook_kind == PER_CLUSTER,
+                    has_filter=filter_bits is not None,
+                    lut_dtype=jnp.dtype(params.lut_dtype or jnp.float32).name,
+                )
+            )
         if bpad:
             v, i = v[:-bpad], i[:-bpad]
         out_v.append(v)

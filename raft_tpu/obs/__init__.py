@@ -1,0 +1,62 @@
+"""raft_tpu.obs — query-path observability: metrics registry,
+device-sync-aware spans, and Perfetto/Chrome-trace export.
+
+Facade re-exporting the pieces the instrumented layers use::
+
+    from raft_tpu import obs
+
+    with obs.span("ivf_pq.search", mode=mode) as sp:
+        out = run(...)
+        sp.sync(out)            # block_until_ready at span end
+    if obs.is_enabled():
+        obs.inc("ivf_pq.search.calls", mode=mode)
+
+Disabled by default; enable with ``RAFT_TPU_OBS=1`` or
+``obs.enable()``. See ``docs/observability.md`` for the metric/span
+taxonomy and ``tools/obs_report.py`` for the artifact summarizer.
+"""
+from raft_tpu.obs.export import (
+    chrome_trace,
+    load_trace,
+    validate_trace,
+    write_metrics_jsonl,
+    write_trace,
+)
+from raft_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    disable,
+    enable,
+    inc,
+    is_enabled,
+    observe,
+    registry,
+    set_gauge,
+)
+from raft_tpu.obs.spans import Span, span, traced
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Span",
+    "chrome_trace",
+    "disable",
+    "enable",
+    "inc",
+    "is_enabled",
+    "load_trace",
+    "observe",
+    "registry",
+    "set_gauge",
+    "span",
+    "traced",
+    "validate_trace",
+    "write_metrics_jsonl",
+    "write_trace",
+]

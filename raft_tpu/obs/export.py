@@ -1,0 +1,117 @@
+"""Export the obs registry as Chrome-trace/Perfetto ``trace_events``
+JSON and as a metrics JSONL snapshot.
+
+The trace format is the Trace Event Format's JSON Object Format: a
+top-level ``{"traceEvents": [...]}`` where each span is a complete
+duration event (``"ph": "X"`` with ``ts``/``dur`` in microseconds) and
+each counter is sampled once at trace end as a counter event
+(``"ph": "C"``). Files written by :func:`write_trace` open directly in
+``ui.perfetto.dev`` (or ``chrome://tracing``); :func:`validate_trace`
+is the schema check the round-trip tests and ``tools/obs_report.py``
+share.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from raft_tpu.obs import metrics as _metrics
+
+
+def chrome_trace(registry: Optional[_metrics.Registry] = None) -> Dict[str, Any]:
+    """Build the ``trace_events`` document from a registry snapshot."""
+    reg = registry or _metrics.registry()
+    pid = os.getpid()
+    events = []
+    end_ts = 0.0
+    for s in reg.spans():
+        end_ts = max(end_ts, s["ts_us"] + s["dur_us"])
+        events.append(
+            {
+                "ph": "X",
+                "name": s["name"],
+                "cat": "raft_tpu",
+                "ts": round(s["ts_us"], 3),
+                "dur": round(s["dur_us"], 3),
+                "pid": pid,
+                "tid": s["tid"],
+                "args": {**s["args"], "depth": s["depth"]},
+            }
+        )
+    snap = reg.as_dict()
+    for key, value in snap["counters"].items():
+        events.append(
+            {
+                "ph": "C",
+                "name": key,
+                "cat": "raft_tpu",
+                "ts": round(end_ts, 3),
+                "pid": pid,
+                "tid": 0,
+                "args": {"value": value},
+            }
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "raft_tpu.obs", "spans_dropped": snap["spans_dropped"]},
+    }
+
+
+def validate_trace(doc: Any) -> None:
+    """Raise ``ValueError`` unless ``doc`` is a well-formed Trace Event
+    Format JSON object (the contract ``ui.perfetto.dev`` parses)."""
+    if not isinstance(doc, dict):
+        raise ValueError("trace document must be a JSON object")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("trace document must have a 'traceEvents' list")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"traceEvents[{i}] is not an object")
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or not ph:
+            raise ValueError(f"traceEvents[{i}] missing phase 'ph'")
+        if ph == "X":
+            if not isinstance(ev.get("name"), str):
+                raise ValueError(f"traceEvents[{i}]: duration event needs a 'name'")
+            for field in ("ts", "dur"):
+                v = ev.get(field)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    raise ValueError(f"traceEvents[{i}]: '{field}' must be a number")
+            if ev["dur"] < 0:
+                raise ValueError(f"traceEvents[{i}]: negative 'dur'")
+            for field in ("pid", "tid"):
+                if not isinstance(ev.get(field), int):
+                    raise ValueError(f"traceEvents[{i}]: '{field}' must be an int")
+        elif ph == "C":
+            if not isinstance(ev.get("name"), str):
+                raise ValueError(f"traceEvents[{i}]: counter event needs a 'name'")
+            if not isinstance(ev.get("args"), dict):
+                raise ValueError(f"traceEvents[{i}]: counter event needs 'args'")
+
+
+def write_trace(path: str, registry: Optional[_metrics.Registry] = None) -> str:
+    """Write (and validate) the Chrome-trace JSON; returns ``path``."""
+    doc = chrome_trace(registry)
+    validate_trace(doc)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return path
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    """Read + validate a trace file written by :func:`write_trace`."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    validate_trace(doc)
+    return doc
+
+
+def write_metrics_jsonl(path: str, registry: Optional[_metrics.Registry] = None) -> str:
+    """Write the metrics + spans JSONL snapshot; returns ``path``."""
+    reg = registry or _metrics.registry()
+    with open(path, "w", encoding="utf-8") as f:
+        reg.dump_jsonl(f)
+    return path

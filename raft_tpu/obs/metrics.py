@@ -1,0 +1,346 @@
+"""Process-local metrics registry: counters, gauges, fixed-bucket
+histograms, and the span buffer the query-path instrumentation reports
+into.
+
+The reference ships recall/latency stats as first-class outputs and
+wraps every nontrivial entry point in NVTX ranges; this is the
+always-on analog for a serving stack: a thread-safe, process-local
+registry the hot paths (``ivf_pq.search``, ``cagra.search``,
+``brute_force``, ``cluster/kmeans``, ``parallel/comms``) write into,
+dumpable as a dict, JSONL, or Prometheus text exposition.
+
+Like :mod:`raft_tpu.core.tracing` (env ``RAFT_TPU_TRACING``) the whole
+subsystem is gated on one process-wide flag — env ``RAFT_TPU_OBS``,
+**default off** — and the disabled path allocates nothing: every
+recording helper checks :func:`is_enabled` first and returns before any
+metric object, label tuple, or span record is created. Instrumented
+call sites keep overhead unmeasurable (<1%) by guarding whole blocks
+with ``if obs.is_enabled():``.
+
+Metric identity is ``(kind, name, sorted labels)``; names are
+dot-separated (``ivf_pq.search.calls``), labels are ``str -> str``
+pairs (``mode="fused"``). The Prometheus dump sanitizes names to the
+exposition charset; the dict/JSONL dumps keep them verbatim.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+_enabled = os.environ.get("RAFT_TPU_OBS", "0").strip().lower() in _TRUTHY
+
+
+def enable(flag: bool = True) -> None:
+    """Turn observability on/off process-wide (``RAFT_TPU_OBS`` analog)."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def disable() -> None:
+    enable(False)
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+#: default histogram buckets for millisecond timings (upper bounds)
+DEFAULT_BUCKETS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+)
+
+LabelsKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Dict[str, Any]) -> LabelsKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonically increasing value (``prometheus counter`` semantics)."""
+
+    __slots__ = ("name", "labels", "value", "_lock")
+    kind = "counter"
+
+    def __init__(self, name: str, labels: LabelsKey, lock: threading.RLock):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+        self._lock = lock
+
+    def inc(self, value: float = 1.0) -> None:
+        with self._lock:
+            self.value += value
+
+
+class Gauge:
+    """Last-write-wins value."""
+
+    __slots__ = ("name", "labels", "value", "_lock")
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: LabelsKey, lock: threading.RLock):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+        self._lock = lock
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, value: float = 1.0) -> None:
+        with self._lock:
+            self.value += value
+
+
+class Histogram:
+    """Fixed-bucket histogram: ``buckets`` are sorted upper bounds; one
+    implicit +Inf bucket catches the tail. Tracks sum and count like the
+    Prometheus histogram type."""
+
+    __slots__ = ("name", "labels", "buckets", "counts", "sum", "count", "_lock")
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        labels: LabelsKey,
+        lock: threading.RLock,
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.labels = labels
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = lock
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.counts[bisect.bisect_left(self.buckets, value)] += 1
+            self.sum += value
+            self.count += 1
+
+
+class Registry:
+    """Thread-safe metric + span store. One process-wide default lives in
+    this module (:func:`registry`); tests may construct their own."""
+
+    def __init__(self, max_spans: int = 200_000):
+        self._lock = threading.RLock()
+        self._metrics: Dict[Tuple[str, str, LabelsKey], Any] = {}
+        self._spans: List[Dict[str, Any]] = []
+        self._t0 = time.perf_counter()
+        self.max_spans = max_spans
+        self.spans_dropped = 0
+
+    # -- get-or-create ----------------------------------------------------
+
+    def _get(self, cls, name: str, labels: Dict[str, Any], **kwargs):
+        key = (cls.kind, name, _labels_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, key[2], self._lock, **kwargs)
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(
+        self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS, **labels
+    ) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    # -- enabled-gated recording (the hot-path API) -----------------------
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        if not _enabled:
+            return
+        self.counter(name, **labels).inc(value)
+
+    def set(self, name: str, value: float, **labels) -> None:
+        if not _enabled:
+            return
+        self.gauge(name, **labels).set(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        if not _enabled:
+            return
+        self.histogram(name, **labels).observe(value)
+
+    # -- spans ------------------------------------------------------------
+
+    def now_us(self) -> float:
+        """Microseconds since this registry's epoch (the trace clock)."""
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def record_span(
+        self,
+        name: str,
+        ts_us: float,
+        dur_us: float,
+        tid: int,
+        depth: int,
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        rec = {
+            "name": name,
+            "ts_us": ts_us,
+            "dur_us": dur_us,
+            "tid": tid,
+            "depth": depth,
+            "args": args or {},
+        }
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self.spans_dropped += 1
+                return
+            self._spans.append(rec)
+
+    def spans(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            snap = list(self._spans)
+        if name is None:
+            return snap
+        return [s for s in snap if s["name"] == name]
+
+    # -- dumps ------------------------------------------------------------
+
+    @staticmethod
+    def _fmt_key(name: str, labels: LabelsKey) -> str:
+        if not labels:
+            return name
+        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        return f"{name}{{{inner}}}"
+
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            metrics = list(self._metrics.values())
+            n_spans = len(self._spans)
+        for m in metrics:
+            key = self._fmt_key(m.name, m.labels)
+            if m.kind == "histogram":
+                out["histograms"][key] = {
+                    "buckets": list(m.buckets),
+                    "counts": list(m.counts),
+                    "sum": m.sum,
+                    "count": m.count,
+                }
+            else:
+                out[m.kind + "s"][key] = m.value
+        out["n_spans"] = n_spans
+        out["spans_dropped"] = self.spans_dropped
+        return out
+
+    def dump_jsonl(self, stream) -> None:
+        """One JSON object per line: every metric, then every span — a
+        self-contained snapshot ``tools/obs_report.py`` can summarize."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+            spans = list(self._spans)
+        for m in metrics:
+            rec: Dict[str, Any] = {
+                "kind": m.kind,
+                "name": m.name,
+                "labels": dict(m.labels),
+            }
+            if m.kind == "histogram":
+                rec.update(
+                    buckets=list(m.buckets), counts=list(m.counts),
+                    sum=m.sum, count=m.count,
+                )
+            else:
+                rec["value"] = m.value
+            stream.write(json.dumps(rec) + "\n")
+        for s in spans:
+            stream.write(json.dumps({"kind": "span", **s}) + "\n")
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (the ``/metrics`` payload)."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        seen_type: set = set()
+        for m in metrics:
+            pname = _prom_name(m.name)
+            if pname not in seen_type:
+                seen_type.add(pname)
+                lines.append(f"# TYPE {pname} {m.kind}")
+            if m.kind == "histogram":
+                cum = 0
+                for ub, c in zip(m.buckets, m.counts):
+                    cum += c
+                    lines.append(
+                        self._fmt_key(
+                            pname + "_bucket", m.labels + (("le", _fmt_float(ub)),)
+                        )
+                        + f" {cum}"
+                    )
+                cum += m.counts[-1]
+                lines.append(
+                    self._fmt_key(pname + "_bucket", m.labels + (("le", "+Inf"),))
+                    + f" {cum}"
+                )
+                lines.append(self._fmt_key(pname + "_sum", m.labels) + f" {_fmt_float(m.sum)}")
+                lines.append(self._fmt_key(pname + "_count", m.labels) + f" {m.count}")
+            else:
+                lines.append(self._fmt_key(pname, m.labels) + f" {_fmt_float(m.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._spans.clear()
+            self.spans_dropped = 0
+            self._t0 = time.perf_counter()
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+def _fmt_float(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+_default = Registry()
+
+
+def registry() -> Registry:
+    """The process-wide default registry."""
+    return _default
+
+
+# module-level conveniences bound to the default registry
+def inc(name: str, value: float = 1.0, **labels) -> None:
+    if not _enabled:
+        return
+    _default.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    if not _enabled:
+        return
+    _default.set(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    if not _enabled:
+        return
+    _default.observe(name, value, **labels)

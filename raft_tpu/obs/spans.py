@@ -1,0 +1,131 @@
+"""Nested, device-sync-aware wall-clock spans.
+
+A :func:`span` is a host-side timing scope recorded into the
+:mod:`raft_tpu.obs.metrics` registry and exportable as Chrome-trace
+``trace_events`` (:mod:`raft_tpu.obs.export`). Two properties matter on
+TPU:
+
+* **Sync-aware.** JAX dispatch is asynchronous: a naive
+  ``perf_counter`` delta around a jitted call measures *enqueue* time,
+  not compute (the dispatch-dominated bug bench.py's ``_hw_context``
+  once had, and the graft-lint ``unsynced-timing`` rule now flags).
+  Registering the op's outputs with :meth:`Span.sync` makes the span
+  end call ``jax.block_until_ready`` on them first, so the recorded
+  duration covers the device work.
+
+* **Zero-cost when disabled.** With ``RAFT_TPU_OBS`` off (the default)
+  ``span()`` yields a shared null object and records nothing — no
+  timestamps, no allocation beyond the generator frame.
+
+Spans nest by wall-clock containment per thread (the Perfetto/Chrome
+``ph: "X"`` convention); ``depth`` is tracked explicitly so reporters
+need not re-derive it.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+from raft_tpu.obs import metrics
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class Span:
+    """Mutable scope handle yielded by :func:`span`."""
+
+    __slots__ = ("name", "args", "_sync")
+
+    def __init__(self, name: str, args: dict):
+        self.name = name
+        self.args = args
+        self._sync: list = []
+
+    def set(self, **kv) -> None:
+        """Attach/overwrite trace args (visible in Perfetto's arg pane)."""
+        self.args.update(kv)
+
+    def sync(self, outputs):
+        """Register ``outputs`` (any pytree of jax arrays) to be
+        ``block_until_ready``-ed at span end; returns ``outputs`` so call
+        sites can wrap a return value in place."""
+        self._sync.append(outputs)
+        return outputs
+
+
+class _NullSpan:
+    """Disabled-path stand-in: same surface, does nothing."""
+
+    __slots__ = ()
+
+    def set(self, **kv) -> None:
+        pass
+
+    def sync(self, outputs):
+        return outputs
+
+
+_NULL = _NullSpan()
+
+
+@contextlib.contextmanager
+def span(name: str, **args) -> Iterator[Any]:
+    """Record a nested wall-clock span named ``name`` into the default
+    registry. ``args`` become Chrome-trace args. Use ``sp.sync(out)`` on
+    the yielded handle to include device completion in the duration."""
+    if not metrics.is_enabled():
+        yield _NULL
+        return
+    reg = metrics.registry()
+    st = _stack()
+    depth = len(st)
+    s = Span(name, dict(args))
+    st.append(s)
+    ts = reg.now_us()
+    t0 = time.perf_counter()
+    try:
+        yield s
+    finally:
+        if s._sync:
+            import jax
+
+            try:
+                jax.block_until_ready(s._sync)
+            except Exception:  # noqa: BLE001 — timing must never mask the real error
+                pass
+        dur = (time.perf_counter() - t0) * 1e6
+        if st and st[-1] is s:
+            st.pop()
+        reg.record_span(name, ts, dur, threading.get_ident(), depth, s.args)
+
+
+def traced(name: Optional[str] = None, sync_result: bool = True):
+    """Decorator form: wrap a function in a span, syncing on its return
+    value by default (the ``annotate`` analog for wall-clock spans)."""
+
+    def deco(fn):
+        label = name or f"{fn.__module__.rsplit('.', 1)[-1]}.{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            if not metrics.is_enabled():
+                return fn(*a, **kw)
+            with span(label) as s:
+                out = fn(*a, **kw)
+                if sync_result:
+                    s.sync(out)
+                return out
+
+        return wrapper
+
+    return deco

@@ -24,6 +24,8 @@ Self-tests mirroring ``comms/comms_test.hpp:117-155`` live in
 """
 from __future__ import annotations
 
+import functools
+import inspect
 from typing import Optional, Sequence
 
 import jax
@@ -32,12 +34,59 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from raft_tpu import obs
 from raft_tpu.core.errors import expects
 from raft_tpu.core.resources import Resources, ensure_resources
 
 DEFAULT_AXIS = "data"
 
 _REDUCE_OPS = ("sum", "max", "min", "prod")
+
+
+def _payload_bytes(x) -> float:
+    """Per-rank payload size of a verb argument, from static shape/dtype
+    metadata only — safe on tracers inside ``shard_map`` bodies."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(x):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            leaf = np.asarray(leaf)
+            shape, dtype = leaf.shape, leaf.dtype
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return float(total)
+
+
+def _instrumented(verb: str):
+    """Wrap a comms verb with obs counters + a trace-time span.
+
+    Verbs execute while XLA is *tracing* a ``shard_map`` body, so there is
+    no device work to sync on here — the span records trace-time only
+    (flagged ``traced=True`` in its args) while the counters record call
+    counts and per-rank payload bytes from static shapes. Composite verbs
+    (``reduce`` → ``allreduce``, ``scatter`` → ``bcast``) also count their
+    inner verb: that matches the collectives actually issued."""
+
+    def deco(fn):
+        sig = inspect.signature(fn)
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            if not obs.is_enabled():
+                return fn(*a, **kw)
+            bound = sig.bind(*a, **kw)
+            bound.apply_defaults()
+            x = bound.arguments.get("x")
+            axis = str(bound.arguments.get("axis", DEFAULT_AXIS))
+            nbytes = _payload_bytes(x) if x is not None else 4.0
+            obs.inc(f"comms.{verb}.calls", axis=axis)
+            obs.inc(f"comms.{verb}.bytes", nbytes, axis=axis)
+            with obs.span(f"comms.{verb}", bytes=nbytes, axis=axis, traced=True):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
 
 
 def make_mesh(
@@ -95,6 +144,7 @@ def comm_size(axis: str = DEFAULT_AXIS) -> int:
     return lax.axis_size(axis)
 
 
+@_instrumented("allreduce")
 def allreduce(x, op: str = "sum", axis: str = DEFAULT_AXIS):
     """``comms_t::allreduce`` (``core/comms.hpp:297``)."""
     expects(op in _REDUCE_OPS, "unknown reduce op %s", op)
@@ -111,6 +161,7 @@ def allreduce(x, op: str = "sum", axis: str = DEFAULT_AXIS):
     return jnp.prod(lax.all_gather(x, axis), axis=0)
 
 
+@_instrumented("allgather")
 def allgather(x, axis: str = DEFAULT_AXIS, tiled: bool = False):
     """``comms_t::allgather`` — concatenate per-rank blocks along axis 0
     (``core/comms.hpp:330``). With ``tiled=False`` a new leading rank axis is
@@ -118,6 +169,7 @@ def allgather(x, axis: str = DEFAULT_AXIS, tiled: bool = False):
     return lax.all_gather(x, axis, tiled=tiled)
 
 
+@_instrumented("reducescatter")
 def reducescatter(x, op: str = "sum", axis: str = DEFAULT_AXIS):
     """``comms_t::reducescatter`` (``core/comms.hpp:367``): elementwise
     reduce across ranks, then scatter equal chunks of axis 0."""
@@ -125,6 +177,7 @@ def reducescatter(x, op: str = "sum", axis: str = DEFAULT_AXIS):
     return lax.psum_scatter(x, axis, tiled=True)
 
 
+@_instrumented("bcast")
 def bcast(x, root: int = 0, axis: str = DEFAULT_AXIS):
     """``comms_t::bcast`` (``core/comms.hpp:343``): every rank receives
     root's block."""
@@ -132,6 +185,7 @@ def bcast(x, root: int = 0, axis: str = DEFAULT_AXIS):
     return jax.tree_util.tree_map(lambda g: g[root], gathered)
 
 
+@_instrumented("reduce")
 def reduce(x, root: int = 0, op: str = "sum", axis: str = DEFAULT_AXIS):
     """``comms_t::reduce``: reduction delivered to ``root``; other ranks get
     zeros (XLA collectives are symmetric, so we mask post-allreduce — same
@@ -141,6 +195,7 @@ def reduce(x, root: int = 0, op: str = "sum", axis: str = DEFAULT_AXIS):
     return jax.tree_util.tree_map(lambda f: jnp.where(is_root, f, jnp.zeros_like(f)), full)
 
 
+@_instrumented("ppermute")
 def ppermute(x, perm: Sequence[tuple], axis: str = DEFAULT_AXIS):
     """Point-to-point ring/permutation send — the device p2p verb set
     (``comms_t::device_send/device_recv``) expressed as XLA's collective
@@ -149,6 +204,7 @@ def ppermute(x, perm: Sequence[tuple], axis: str = DEFAULT_AXIS):
     return lax.ppermute(x, axis, perm)
 
 
+@_instrumented("gather")
 def gather(x, root: int = 0, axis: str = DEFAULT_AXIS):
     """``comms_t::gather`` (``core/comms.hpp:400``): root receives every
     rank's block stacked on a new leading axis; other ranks get zeros.
@@ -159,6 +215,7 @@ def gather(x, root: int = 0, axis: str = DEFAULT_AXIS):
     return jax.tree_util.tree_map(lambda a: jnp.where(is_root, a, jnp.zeros_like(a)), g)
 
 
+@_instrumented("gatherv")
 def gatherv(x, valid_n, root: int = 0, axis: str = DEFAULT_AXIS):
     """``comms_t::gatherv`` (``core/comms.hpp:417``): variable-size gather.
     XLA needs static shapes, so each rank contributes a padded block ``x
@@ -172,6 +229,7 @@ def gatherv(x, valid_n, root: int = 0, axis: str = DEFAULT_AXIS):
     return mask(blocks), mask(sizes)
 
 
+@_instrumented("scatter")
 def scatter(x, root: int = 0, axis: str = DEFAULT_AXIS):
     """Inverse of :func:`gather`: ``x [size, ...]`` on root (every rank
     passes the same-shaped buffer under SPMD); rank r receives block
@@ -184,6 +242,7 @@ def scatter(x, root: int = 0, axis: str = DEFAULT_AXIS):
     )
 
 
+@_instrumented("send_recv")
 def send_recv(x, src: int, dst: int, axis: str = DEFAULT_AXIS):
     """One device p2p transfer (``comms_t::device_send``/``device_recv``
     pair, ``core/comms.hpp:506-540``): rank ``dst`` receives ``src``'s
@@ -191,6 +250,7 @@ def send_recv(x, src: int, dst: int, axis: str = DEFAULT_AXIS):
     return lax.ppermute(x, axis, [(src, dst)])
 
 
+@_instrumented("device_sendrecv")
 def device_sendrecv(x, partner_of: Sequence[tuple], axis: str = DEFAULT_AXIS):
     """``comms_t::device_sendrecv`` (``core/comms.hpp:559``): simultaneous
     exchange — each (a, b) pair in ``partner_of`` ships a→b AND b→a in one
@@ -202,6 +262,7 @@ def device_sendrecv(x, partner_of: Sequence[tuple], axis: str = DEFAULT_AXIS):
     return lax.ppermute(x, axis, perm)
 
 
+@_instrumented("multicast_sendrecv")
 def multicast_sendrecv(x, pairs: Sequence[tuple], axis: str = DEFAULT_AXIS):
     """``comms_t::device_multicast_sendrecv`` (``core/comms.hpp:580``):
     one source may feed several destinations — not a permutation, so XLA's
@@ -222,6 +283,7 @@ def multicast_sendrecv(x, pairs: Sequence[tuple], axis: str = DEFAULT_AXIS):
     )
 
 
+@_instrumented("barrier")
 def barrier(axis: str = DEFAULT_AXIS):
     """``comms_t::barrier`` (``core/comms.hpp:389``): XLA programs are
     bulk-synchronous per collective, so a tiny psum is a true rendezvous.

@@ -1,0 +1,22 @@
+"""Seeded violation: unsynced-timing (exactly one).
+
+The delta below times `step` — a jitted function — with no
+block_until_ready or scalar fetch inside the region, so it measures
+async dispatch (enqueue), not device compute.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    return jnp.tanh(x) * 2.0
+
+
+def measure(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    dt = time.perf_counter() - t0  # LINT-HERE
+    return y, dt

@@ -3,10 +3,12 @@ the way graft-lint wants them. Must produce zero violations.
 
 Covers the negative space of every rule: static-arg branches,
 trace-time shape checks, numpy on static values, explicit dtypes,
-module-scope jit, aligned tiles within budget, and a *derived* (not
-hard-coded) chunk budget.
+module-scope jit, synced wall-clock timing around jitted calls,
+aligned tiles within budget, and a *derived* (not hard-coded) chunk
+budget.
 """
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +29,22 @@ def fold(x, squared=False):
 
 
 relu = jax.jit(lambda x: jnp.maximum(x, 0.0))  # module scope, not a loop
+
+
+def timed_relu(x):
+    # synced timing: block_until_ready inside the region keeps the delta
+    # honest, so unsynced-timing stays quiet
+    t0 = time.perf_counter()
+    y = jax.block_until_ready(relu(x))
+    dt = time.perf_counter() - t0
+    # scalar-fetch sync is the other accepted idiom
+    t1 = time.perf_counter()
+    s = float(jnp.sum(relu(x)))
+    dt2 = time.perf_counter() - t1
+    # untimed region: a delta with no jitted call inside is fine too
+    t2 = time.perf_counter()
+    overhead = time.perf_counter() - t2
+    return y, s, dt + dt2 + overhead
 
 
 def _copy_kernel(x_ref, o_ref, acc_ref):

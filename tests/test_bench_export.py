@@ -87,3 +87,56 @@ def test_artifact_recorder_incremental(tmp_path):
         doc = json.load(f)
     assert doc["context"]["extra"] == 1 and doc["context"]["device"] == "test"
     assert not os.path.exists(rec.path + ".tmp")
+
+
+def _load_bench():
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_bench_under_test", os.path.join(root, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_compute_efficiency_fractions_bounded():
+    """Device-resident delta-timed probes (``_hw_context``) mean a kernel
+    can at best match the measured peak: for consistent inputs every
+    efficiency fraction must land in (0, 1]."""
+    bench = _load_bench()
+    hw = {"bf16_matmul_tflops": 100.0, "hbm_copy_gbps": 800.0}
+    ops = {
+        "ivf_flat": {"stream_gbps_est": 640.0},
+        "cagra_fused": {"stream_gbps_est": 200.0},
+    }
+    eff = bench.compute_efficiency(ops, hw, exact_tflops=42.0)
+    assert eff["exact_achieved_tflops"] == 42.0
+    for key in (
+        "mfu_vs_measured_peak",
+        "fused_frac_of_measured_copy_bw",
+        "cagra_fused_frac_of_measured_copy_bw",
+    ):
+        assert eff[key] is not None
+        assert 0.0 < eff[key] <= 1.0, f"{key}={eff[key]} — probe is lying"
+    assert eff["fused_stream_gbps_est"] == 640.0
+    assert eff["cagra_fused_stream_gbps_est"] == 200.0
+
+
+def test_compute_efficiency_guards_zero_peak():
+    bench = _load_bench()
+    hw = {"bf16_matmul_tflops": 0.0, "hbm_copy_gbps": 0.0}
+    ops = {"ivf_flat": {"stream_gbps_est": 640.0}}
+    eff = bench.compute_efficiency(ops, hw, exact_tflops=42.0)
+    assert eff["mfu_vs_measured_peak"] is None
+    assert eff["fused_frac_of_measured_copy_bw"] is None
+
+
+def test_compute_efficiency_absent_ops_keys():
+    bench = _load_bench()
+    hw = {"bf16_matmul_tflops": 100.0, "hbm_copy_gbps": 800.0}
+    eff = bench.compute_efficiency({}, hw, exact_tflops=10.0)
+    assert "fused_stream_gbps_est" not in eff
+    assert "cagra_fused_frac_of_measured_copy_bw" not in eff
+    assert eff["mfu_vs_measured_peak"] == 0.1

@@ -25,6 +25,7 @@ BAD = {
     "bad_static_args.py": "static-args",
     "bad_jit_in_loop.py": "jit-in-loop",
     "bad_implicit_dtype.py": "implicit-dtype",
+    "bad_unsynced_timing.py": "unsynced-timing",
     "bad_tile_misaligned.py": "tile-align",
     "bad_stale_budget.py": "stale-budget",
     "bad_vmem_budget.py": "vmem-budget",

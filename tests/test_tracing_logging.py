@@ -1,0 +1,183 @@
+"""core.tracing (NVTX-range analogs) and core.logging (spdlog analog):
+enable/disable zero-cost paths, annotation labels, callback sink,
+pattern, and level round-trips.
+"""
+import contextlib
+
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.core import logging as rlog
+from raft_tpu.core import tracing
+
+
+@pytest.fixture
+def tracing_state():
+    """Save/restore the module-global tracing toggle."""
+    was = tracing.is_enabled()
+    yield
+    tracing.enable(was)
+
+
+@pytest.fixture
+def logging_state():
+    """Detach any callback sink and restore INFO afterwards."""
+    yield
+    rlog.set_callback(None)
+    rlog.set_level(rlog.LEVEL_INFO)
+
+
+# -- tracing ----------------------------------------------------------------
+
+
+def test_enable_disable_round_trip(tracing_state):
+    tracing.enable(False)
+    assert not tracing.is_enabled()
+    tracing.enable()
+    assert tracing.is_enabled()
+
+
+def test_push_range_enabled_and_disabled(tracing_state):
+    for flag in (True, False):
+        tracing.enable(flag)
+        with tracing.push_range("unit.range"):
+            x = jnp.arange(4.0) + 1
+        assert float(x.sum()) == 10.0
+    # the RAII alias from the reference is the same contextmanager
+    assert tracing.range is tracing.push_range
+
+
+def test_push_range_disabled_is_bare_yield(tracing_state, monkeypatch):
+    """Zero-cost when off: the profiler annotation must not be built."""
+    import jax
+
+    calls = []
+
+    class Boom:
+        def __init__(self, name):
+            calls.append(name)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", Boom)
+    tracing.enable(False)
+    with tracing.push_range("off"):
+        pass
+    assert calls == []
+    tracing.enable(True)
+    with tracing.push_range("on"):
+        pass
+    assert calls == ["on"]
+
+
+def test_annotate_labels_and_passthrough(tracing_state, monkeypatch):
+    import jax
+
+    seen = []
+    monkeypatch.setattr(
+        jax.profiler,
+        "TraceAnnotation",
+        lambda name: (seen.append(name), contextlib.nullcontext())[1],
+    )
+
+    @tracing.annotate()
+    def work(a, b=1):
+        return a + b
+
+    @tracing.annotate("custom.label")
+    def other():
+        return 7
+
+    tracing.enable(True)
+    assert work(2, b=3) == 5
+    assert other() == 7
+    assert seen == [f"raft_tpu::{work.__wrapped__.__qualname__}", "custom.label"]
+    assert work.__name__ == "work"  # functools.wraps preserved
+
+    seen.clear()
+    tracing.enable(False)
+    assert work(1) == 2  # disabled: plain call, no annotation objects
+    assert seen == []
+
+
+def test_named_scope(tracing_state):
+    tracing.enable(False)
+    assert isinstance(tracing.named_scope("off"), contextlib.nullcontext)
+    tracing.enable(True)
+    scope = tracing.named_scope("hlo.scope")
+    assert not isinstance(scope, contextlib.nullcontext)
+    with scope:
+        y = jnp.ones((2,)) * 2
+    assert float(y[0]) == 2.0
+
+
+# -- logging ----------------------------------------------------------------
+
+
+def test_set_level_get_level_round_trip(logging_state):
+    for lvl in (
+        rlog.LEVEL_OFF,
+        rlog.LEVEL_CRITICAL,
+        rlog.LEVEL_ERROR,
+        rlog.LEVEL_WARN,
+        rlog.LEVEL_INFO,
+        rlog.LEVEL_DEBUG,
+        rlog.LEVEL_TRACE,
+    ):
+        rlog.set_level(lvl)
+        assert rlog.get_level() == lvl
+    rlog.set_level(999)  # unknown levels fall back to INFO
+    assert rlog.get_level() == rlog.LEVEL_INFO
+
+
+def test_callback_sink_receives_messages(logging_state):
+    got = []
+    rlog.set_callback(lambda lvl, msg: got.append((lvl, msg)))
+    rlog.set_pattern("%(message)s")
+    rlog.set_level(rlog.LEVEL_INFO)
+    rlog.info("hello %d", 42)
+    rlog.warn("careful")
+    rlog.debug("filtered out")  # below INFO
+    assert [m for _, m in got] == ["hello 42", "careful"]
+    import logging as pylogging
+
+    assert got[0][0] == pylogging.INFO
+    assert got[1][0] == pylogging.WARNING
+
+
+def test_set_pattern_changes_format(logging_state):
+    got = []
+    rlog.set_callback(lambda lvl, msg: got.append(msg))
+    rlog.set_level(rlog.LEVEL_INFO)
+    rlog.set_pattern("[%(levelname)s] %(message)s")
+    rlog.error("boom")
+    assert got == ["[ERROR] boom"]
+
+
+def test_trace_macro_and_level_gate(logging_state):
+    got = []
+    rlog.set_callback(lambda lvl, msg: got.append(msg))
+    rlog.set_pattern("%(message)s")
+    rlog.set_level(rlog.LEVEL_TRACE)
+    rlog.trace("deep %s", "detail")
+    assert got == ["deep detail"]
+    got.clear()
+    rlog.set_level(rlog.LEVEL_OFF)
+    rlog.critical("silenced")
+    assert got == []
+
+
+def test_callback_removal(logging_state):
+    got = []
+    rlog.set_callback(lambda lvl, msg: got.append(msg))
+    rlog.set_pattern("%(message)s")
+    rlog.set_level(rlog.LEVEL_INFO)
+    rlog.info("one")
+    rlog.set_callback(None)
+    assert rlog._cb_handler not in rlog.logger.handlers
+    rlog.info("two")  # no sink: dropped by the NullHandler
+    assert got == ["one"]

@@ -20,6 +20,10 @@ AST:
 * ``implicit-dtype``  — ``jnp.arange``/``jnp.linspace`` with float
   arguments and no explicit ``dtype``: the result dtype flips between
   f32 and f64 with the ``jax_enable_x64`` flag.
+* ``unsynced-timing`` — a wall-clock delta (``time.perf_counter() -
+  t0``) around a call to a module-local jitted function with no device
+  sync inside the timed region: async dispatch means the delta measures
+  enqueue, not compute.
 
 The taint analysis is a deliberate approximation: a name is *traced* if
 it is a non-static parameter of the jitted function or was assigned
@@ -536,10 +540,192 @@ class ImplicitDtypeChecker(Checker):
                 )
 
 
+# -- unsynced-timing --------------------------------------------------------
+
+#: clock functions on the ``time`` module whose subtraction forms a delta
+_TIMER_FUNCS = {"time", "perf_counter", "monotonic"}
+#: bare calls that force device completion (scalar fetch / host copy)
+_SYNC_NAME_CALLS = {"float", "int", "bool"}
+#: attribute calls that force device completion
+_SYNC_ATTRS = {"block_until_ready", "device_get", "asarray", "item"}
+
+
+class _TimeImports(ast.NodeVisitor):
+    """Module-level aliases of the ``time`` module and its clocks."""
+
+    def __init__(self) -> None:
+        self.time_mod: Set[str] = set()
+        self.clocks: Set[str] = set()  # from time import perf_counter [as pc]
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name == "time":
+                self.time_mod.add(a.asname or "time")
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for a in node.names:
+                if a.name in _TIMER_FUNCS:
+                    self.clocks.add(a.asname or a.name)
+
+
+def _time_imports(module: LintModule) -> _TimeImports:
+    cached = getattr(module, "_graft_time_imports", None)
+    if cached is None:
+        cached = _TimeImports()
+        cached.visit(module.tree)
+        module._graft_time_imports = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def _is_clock_call(node: ast.AST, timp: _TimeImports) -> bool:
+    """``time.perf_counter()`` / ``perf_counter()`` (module-level alias)."""
+    if not (isinstance(node, ast.Call) and not node.args and not node.keywords):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in timp.clocks
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in _TIMER_FUNCS
+        and isinstance(f.value, ast.Name)
+        and f.value.id in timp.time_mod
+    )
+
+
+def _jitted_names(module: LintModule) -> Set[str]:
+    """Module-local names known to be jitted callables: jit-decorated
+    defs plus ``name = jax.jit(...)`` / ``name = partial(jax.jit, ...)``
+    assignments."""
+    cached = getattr(module, "_graft_jitted_names", None)
+    if cached is not None:
+        return cached
+    imp = _module_imports(module)
+    names: Set[str] = {fn.name for fn, _, _ in iter_jitted_functions(module)}
+    for node in ast.walk(module.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and _jit_call_keywords(node.value, imp) is not None
+        ):
+            for t in node.targets:
+                names.update(_target_names(t))
+    module._graft_jitted_names = names  # type: ignore[attr-defined]
+    return names
+
+
+def _walk_skip_defs(root: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested function bodies
+    (they run on their own clock, not inside this timed region)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _iter_bodies(tree: ast.AST) -> Iterator[Sequence[ast.stmt]]:
+    for node in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(node, field, None)
+            if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
+                yield body
+
+
+def _calls_jitted(stmts: Sequence[ast.stmt], jitted: Set[str]) -> bool:
+    for stmt in stmts:
+        for node in _walk_skip_defs(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in jitted
+            ):
+                return True
+    return False
+
+
+def _has_sync(stmts: Sequence[ast.stmt]) -> bool:
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _SYNC_NAME_CALLS:
+                return True
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS:
+                return True
+    return False
+
+
+class UnsyncedTimingChecker(Checker):
+    rule = "unsynced-timing"
+    doc = (
+        "wall-clock delta around a call to a jitted function with no "
+        "device sync in the timed region — async dispatch means the "
+        "delta measures enqueue time, not compute; block_until_ready "
+        "(or a scalar fetch) before reading the clock."
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        timp = _time_imports(module)
+        if not (timp.time_mod or timp.clocks):
+            return
+        jitted = _jitted_names(module)
+        if not jitted:
+            return
+        for body in _iter_bodies(module.tree):
+            yield from self._scan_body(module, body, timp, jitted)
+
+    def _scan_body(
+        self,
+        module: LintModule,
+        body: Sequence[ast.stmt],
+        timp: _TimeImports,
+        jitted: Set[str],
+    ) -> Iterator[Violation]:
+        starts: Dict[str, int] = {}  # timer name -> index of its assignment
+        for i, stmt in enumerate(body):
+            if isinstance(stmt, ast.Assign) and _is_clock_call(stmt.value, timp):
+                for t in stmt.targets:
+                    for name in _target_names(t):
+                        starts[name] = i
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs are scanned as their own bodies
+            for node in _walk_skip_defs(stmt):
+                if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+                    continue
+                right = node.right
+                if not (isinstance(right, ast.Name) and right.id in starts):
+                    continue
+                left_ok = _is_clock_call(node.left, timp) or (
+                    isinstance(node.left, ast.Name) and node.left.id in starts
+                )
+                if not left_ok:
+                    continue
+                region = body[starts[right.id] + 1 : i + 1]
+                if _calls_jitted(region, jitted) and not _has_sync(region):
+                    yield self.violation(
+                        module, node,
+                        f"`{right.id}` times a region that calls a jitted "
+                        "function but never syncs — jax dispatch is async, so "
+                        "this measures enqueue, not compute; add "
+                        "jax.block_until_ready(...) (or a scalar fetch) "
+                        "before the closing clock read",
+                    )
+                # one report per timed region: a reused start (display,
+                # logging) must not re-flag the same measurement
+                starts.pop(right.id, None)
+
+
 CHECKERS = [
     TracedBranchChecker(),
     NumpyInJitChecker(),
     StaticArgsChecker(),
     JitInLoopChecker(),
     ImplicitDtypeChecker(),
+    UnsyncedTimingChecker(),
 ]

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Summarize raft_tpu.obs artifacts: a metrics JSONL snapshot
+(``obs.write_metrics_jsonl``) and/or a Chrome-trace JSON
+(``obs.write_trace``).
+
+Usage::
+
+    python tools/obs_report.py bench_artifacts/metrics.jsonl
+    python tools/obs_report.py bench_artifacts/trace.json --top 15
+    python tools/obs_report.py bench_artifacts/metrics.jsonl bench_artifacts/trace.json
+
+Prints the top spans by **self-time** (wall-clock minus the wall-clock of
+nested child spans, computed per thread with a stack sweep — the number
+that says where time actually went, not just which outermost span
+contained it), then the counter/gauge tables and histogram summaries.
+
+When several files are given, spans and metrics are each taken from the
+first file that provides them (a JSONL snapshot and the trace exported
+from the same registry describe the same spans — reading both would
+double-count). Pure stdlib; safe to run anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def parse_file(path: str) -> Dict[str, Any]:
+    """Parse one artifact into ``{"spans": [...], "counters": {...},
+    "gauges": {...}, "histograms": {...}}``. JSONL snapshots carry all
+    four; Chrome traces carry spans (ph "X") and counters (ph "C")."""
+    out: Dict[str, Any] = {"spans": [], "counters": {}, "gauges": {}, "histograms": {}}
+    if path.endswith(".jsonl"):
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                kind = rec.get("kind")
+                if kind == "span":
+                    out["spans"].append(
+                        {
+                            "name": rec["name"],
+                            "ts": float(rec["ts_us"]),
+                            "dur": float(rec["dur_us"]),
+                            "tid": rec.get("tid", 0),
+                        }
+                    )
+                elif kind in ("counter", "gauge"):
+                    out[kind + "s"][_key(rec)] = rec.get("value", 0.0)
+                elif kind == "histogram":
+                    out["histograms"][_key(rec)] = {
+                        "count": rec.get("count", 0),
+                        "sum": rec.get("sum", 0.0),
+                    }
+        return out
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    for ev in doc.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph == "X":
+            out["spans"].append(
+                {
+                    "name": ev["name"],
+                    "ts": float(ev["ts"]),
+                    "dur": float(ev["dur"]),
+                    "tid": ev.get("tid", 0),
+                }
+            )
+        elif ph == "C":
+            out["counters"][ev["name"]] = ev.get("args", {}).get("value", 0.0)
+    return out
+
+
+def _key(rec: Dict[str, Any]) -> str:
+    labels = rec.get("labels") or {}
+    if not labels:
+        return rec["name"]
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{rec['name']}{{{inner}}}"
+
+
+def self_times(spans: List[Dict[str, Any]]) -> List[Tuple[str, float, float]]:
+    """Per-span (name, dur_us, self_us) via a per-tid stack sweep over
+    wall-clock containment: a span's self-time is its duration minus the
+    durations of the spans directly nested inside it."""
+    out: List[Tuple[str, float, float]] = []
+    by_tid: Dict[Any, List[Dict[str, Any]]] = {}
+    for s in spans:
+        by_tid.setdefault(s["tid"], []).append(s)
+    for tid_spans in by_tid.values():
+        # parents first: earlier start, then longer duration on ties
+        tid_spans.sort(key=lambda s: (s["ts"], -s["dur"]))
+        stack: List[List[Any]] = []  # [end_ts, name, dur, self]
+        def flush(upto: float) -> None:
+            while stack and stack[-1][0] <= upto:
+                end, name, dur, self_us = stack.pop()
+                if stack:
+                    stack[-1][3] -= dur
+                out.append((name, dur, max(self_us, 0.0)))
+        for s in tid_spans:
+            flush(s["ts"])
+            stack.append([s["ts"] + s["dur"], s["name"], s["dur"], s["dur"]])
+        flush(float("inf"))
+    return out
+
+
+def aggregate(per_span: List[Tuple[str, float, float]]) -> List[Dict[str, Any]]:
+    """Aggregate per-span rows into per-name totals sorted by self-time."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for name, dur, self_us in per_span:
+        row = agg.setdefault(name, {"name": name, "count": 0, "total_us": 0.0, "self_us": 0.0})
+        row["count"] += 1
+        row["total_us"] += dur
+        row["self_us"] += self_us
+    return sorted(agg.values(), key=lambda r: -r["self_us"])
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
+    def fmt(r):
+        return "  ".join(str(c).ljust(w) if i == 0 else str(c).rjust(w)
+                         for i, (c, w) in enumerate(zip(r, widths)))
+    lines = [fmt(header), fmt(["-" * w for w in widths])]
+    lines += [fmt(r) for r in rows]
+    return "\n".join(lines)
+
+
+def render_report(*paths: str, top: int = 10) -> str:
+    """Build the text report over one or more obs artifact files."""
+    spans: List[Dict[str, Any]] = []
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    histograms: Dict[str, Dict[str, Any]] = {}
+    for path in paths:
+        if not path:
+            continue
+        parsed = parse_file(path)
+        if parsed["spans"] and not spans:
+            spans = parsed["spans"]
+        if parsed["counters"] and not counters:
+            counters = parsed["counters"]
+        if parsed["gauges"] and not gauges:
+            gauges = parsed["gauges"]
+        if parsed["histograms"] and not histograms:
+            histograms = parsed["histograms"]
+
+    sections: List[str] = ["# obs report"]
+    if spans:
+        agg = aggregate(self_times(spans))[:top]
+        rows = [
+            [r["name"], r["count"],
+             f"{r['self_us'] / 1e3:.2f}", f"{r['total_us'] / 1e3:.2f}",
+             f"{r['total_us'] / 1e3 / r['count']:.2f}"]
+            for r in agg
+        ]
+        sections.append(f"## top {len(rows)} spans by self-time\n"
+                        + _table(rows, ["span", "count", "self_ms", "total_ms", "mean_ms"]))
+    if counters:
+        rows = [[k, f"{v:g}"] for k, v in sorted(counters.items())]
+        sections.append("## counters\n" + _table(rows, ["counter", "value"]))
+    if gauges:
+        rows = [[k, f"{v:g}"] for k, v in sorted(gauges.items())]
+        sections.append("## gauges\n" + _table(rows, ["gauge", "value"]))
+    if histograms:
+        rows = [
+            [k, h["count"], f"{h['sum'] / h['count']:.3f}" if h["count"] else "-"]
+            for k, h in sorted(histograms.items())
+        ]
+        sections.append("## histograms\n" + _table(rows, ["histogram", "count", "mean"]))
+    if len(sections) == 1:
+        sections.append("(no spans or metrics found)")
+    return "\n\n".join(sections)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="metrics .jsonl and/or Chrome-trace .json files")
+    ap.add_argument("--top", type=int, default=10, help="span rows to show")
+    ns = ap.parse_args(argv)
+    try:
+        print(render_report(*ns.paths, top=ns.top))
+    except (OSError, json.JSONDecodeError, KeyError, ValueError) as e:
+        print(f"obs_report: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
