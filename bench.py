@@ -334,7 +334,7 @@ def _run_tpu_subprocess(hard_s, attempt=1):
                 # can emit structured-JSON log lines on the merged stream
                 try:
                     saw_json[0] |= json.loads(line).get("metric") == METRIC
-                except (json.JSONDecodeError, AttributeError):
+                except (json.JSONDecodeError, AttributeError):  # graft-lint: ignore[silent-except] — non-result log line
                     pass
 
     import threading

@@ -66,6 +66,54 @@ class Dataset:
         return gt[:, :k]
 
 
+def download_file(
+    url: str,
+    dest: str,
+    policy: Optional["RetryPolicy"] = None,
+    timeout: float = 60.0,
+    chunk: int = 1 << 20,
+) -> str:
+    """Fetch ``url`` to ``dest`` with retry + atomic temp-then-rename.
+
+    The analog of ``get_dataset/__main__.py``'s wget stage, hardened the
+    way the robustness layer hardens everything idempotent: transient
+    network errors are retried per ``policy``
+    (:class:`raft_tpu.robust.retry.RetryPolicy`, default 3 attempts with
+    backoff), and a partially-fetched file can never be observed at
+    ``dest`` — bytes land in ``dest + ".tmp<pid>"`` and are renamed only
+    after a complete read. Returns ``dest``. (This environment has zero
+    egress, so tests exercise it against ``file://`` URLs.)
+    """
+    import urllib.error
+    import urllib.request
+
+    from raft_tpu.robust.retry import RetryPolicy, retry_call
+
+    if policy is None:
+        policy = RetryPolicy(
+            max_attempts=3, base_delay_s=0.5, max_delay_s=10.0,
+            retryable=(urllib.error.URLError, ConnectionError, TimeoutError, OSError),
+        )
+
+    def _fetch() -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+        tmp = dest + f".tmp{os.getpid()}"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
+                while True:
+                    buf = r.read(chunk)
+                    if not buf:
+                        break
+                    f.write(buf)
+            os.replace(tmp, dest)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return dest
+
+    return retry_call(_fetch, policy=policy, op="datasets.download")
+
+
 def _fingerprint(ds: Dataset) -> str:
     h = hashlib.sha1()
     h.update(f"{ds.name}:{ds.base.shape}:{ds.queries.shape}:{ds.metric}".encode())

@@ -14,6 +14,27 @@ class LogicError(RaftError):
     """Analog of ``raft::logic_error`` raised by ``RAFT_EXPECTS``."""
 
 
+class ShardFailure(RaftError):
+    """One shard of a distributed operation failed (lost device, failed
+    collective participant). Degraded-mode search catches this and
+    continues over the surviving shards (:mod:`raft_tpu.robust.degrade`)."""
+
+    def __init__(self, msg: str = "shard failure", shard: int = -1):
+        super().__init__(msg)
+        self.shard = shard
+
+
+class KernelFailure(RaftError):
+    """A fused accelerator kernel failed to lower/compile/execute.
+    ``mode="auto"`` dispatch catches this and falls back to the XLA path
+    (:mod:`raft_tpu.robust`)."""
+
+
+class CorruptIndexError(RaftError):
+    """A serialized index snapshot failed its integrity check (bad CRC,
+    truncated payload). Raised by :func:`raft_tpu.core.serialize.load_stream`."""
+
+
 def expects(cond: bool, msg: str, *args) -> None:
     """Runtime check macro analog of ``RAFT_EXPECTS(cond, fmt, ...)``."""
     if not cond:

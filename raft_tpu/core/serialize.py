@@ -10,16 +10,23 @@ built from these primitives plus a versioned header, mirroring
 from __future__ import annotations
 
 import io
+import os
 import struct
-from typing import BinaryIO, Union
+import zlib
+from typing import BinaryIO, Callable, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.core.errors import CorruptIndexError
+
 # Serialization format version tag written by dump_header; bump on breaking
 # layout changes (the reference keeps a per-index `serialization_version`).
-SERIALIZATION_VERSION = 3
+# v4 is the checksummed envelope (save_stream/load_stream): the v<=3
+# preamble, then index-format version + payload length + CRC32 + payload.
+# v<=3 streams (bare preamble + body) still load, unchecked.
+SERIALIZATION_VERSION = 4
 _MAGIC = b"RAFT_TPU"
 
 
@@ -111,3 +118,70 @@ def check_header(stream: BinaryIO, kind: str) -> int:
     if version > SERIALIZATION_VERSION:
         raise ValueError(f"serialization version {version} is newer than supported {SERIALIZATION_VERSION}")
     return version
+
+
+# ---------------------------------------------------------------------------
+# v4 checksummed envelope + atomic file helpers
+# ---------------------------------------------------------------------------
+
+
+def save_stream(stream: BinaryIO, kind: str, version: int, body: bytes) -> None:
+    """Write an index snapshot in the v4 checksummed envelope.
+
+    Layout: the v<=3 preamble (magic + kind + envelope version 4), then the
+    index-format ``version`` (u32, what per-index ``load`` branches on),
+    payload length (u64), CRC32 of the payload (u32), payload bytes.
+    The CRC covers the payload only — header corruption already fails the
+    magic/kind/version checks."""
+    dump_header(stream, kind, SERIALIZATION_VERSION)
+    serialize_scalar(stream, version, "uint32")
+    serialize_scalar(stream, len(body), "uint64")
+    serialize_scalar(stream, zlib.crc32(body) & 0xFFFFFFFF, "uint32")
+    stream.write(body)
+
+
+def load_stream(stream: BinaryIO, kind: str) -> Tuple[int, BinaryIO]:
+    """Open an index snapshot: returns ``(index_version, payload_stream)``.
+
+    v4 envelopes are length- and CRC-verified (raising
+    :class:`CorruptIndexError` on truncation or bit damage) and the
+    payload is returned as an in-memory stream; v<=3 legacy streams are
+    returned as-is, unchecked, with the preamble version standing in for
+    the index version (exactly what pre-v4 ``load`` consumed)."""
+    version = check_header(stream, kind)
+    # chaos seam: storage-layer faults (CorruptIndexError, injected
+    # latency) fire after the header parse, before payload verification
+    from raft_tpu.robust import faults
+
+    faults.fire("serialize.load", kind=kind)
+    if version < 4:
+        return version, stream
+    index_version = int(deserialize_scalar(stream, "uint32"))
+    length = int(deserialize_scalar(stream, "uint64"))
+    crc = int(deserialize_scalar(stream, "uint32"))
+    payload = stream.read(length)
+    if len(payload) != length:
+        raise CorruptIndexError(
+            f"truncated {kind} snapshot: payload is {len(payload)} of {length} bytes"
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise CorruptIndexError(f"{kind} snapshot failed its CRC32 check")
+    return index_version, io.BytesIO(payload)
+
+
+def atomic_write(path: str, writer: Callable[[BinaryIO], None]) -> str:
+    """Run ``writer`` against a temp file, fsync, then rename onto
+    ``path`` — a torn write can never be observed at ``path``."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path

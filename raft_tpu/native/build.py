@@ -17,11 +17,20 @@ import sysconfig
 import threading
 from typing import Optional
 
+from raft_tpu.robust.retry import RetryError, RetryPolicy, retry_call
+
 _CACHE_DIR = os.path.join(
     os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "raft_tpu_native"
 )
 _LOCK = threading.Lock()
 _LOADED: dict = {}
+
+#: fs/toolchain hiccups (NFS races, OOM-killed cc) are transient; a failed
+#: compile only costs the Python fallback, so keep the retry budget small
+_COMPILE_RETRY = RetryPolicy(
+    max_attempts=2, base_delay_s=0.2,
+    retryable=(subprocess.SubprocessError, OSError),
+)
 
 
 def _compiler() -> Optional[str]:
@@ -59,10 +68,14 @@ def load_native(name: str) -> Optional[ctypes.CDLL]:
             os.makedirs(_CACHE_DIR, exist_ok=True)
             tmp = out + f".tmp{os.getpid()}"
             cmd = cc.split() + ["-O3", "-shared", "-fPIC", "-o", tmp, src]
-            try:
+
+            def _compile():
                 subprocess.run(cmd, check=True, capture_output=True, timeout=120)
                 os.replace(tmp, out)
-            except (subprocess.SubprocessError, OSError):
+
+            try:
+                retry_call(_compile, policy=_COMPILE_RETRY, op="native.compile")
+            except RetryError:
                 _LOADED[name] = None
                 return None
         try:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import io
 from typing import BinaryIO, Optional, Tuple
 
 import jax
@@ -376,9 +377,7 @@ _KIND = "brute_force"
 _VERSION = 1
 
 
-def save(index: BruteForceIndex, stream: BinaryIO) -> None:
-    """Serialize (``neighbors/brute_force_serialize.cuh`` analog)."""
-    ser.dump_header(stream, _KIND, _VERSION)
+def _write_body(index: BruteForceIndex, stream: BinaryIO) -> None:
     ser.serialize_scalar(stream, int(index.metric), "int32")
     ser.serialize_scalar(stream, float(index.metric_arg), "float64")
     ser.serialize_scalar(stream, int(index.norms is not None), "int32")
@@ -387,15 +386,33 @@ def save(index: BruteForceIndex, stream: BinaryIO) -> None:
         ser.serialize_array(stream, index.norms)
 
 
+def save(index: BruteForceIndex, stream: BinaryIO) -> None:
+    """Serialize (``neighbors/brute_force_serialize.cuh`` analog) in the
+    checksummed v4 envelope."""
+    body = io.BytesIO()
+    _write_body(index, body)
+    ser.save_stream(stream, _KIND, _VERSION, body.getvalue())
+
+
 def load(stream: BinaryIO, res: Optional[Resources] = None) -> BruteForceIndex:
     ensure_resources(res)
-    ser.check_header(stream, _KIND)
-    metric = DistanceType(ser.deserialize_scalar(stream, "int32"))
-    metric_arg = float(ser.deserialize_scalar(stream, "float64"))
-    has_norms = bool(ser.deserialize_scalar(stream, "int32"))
-    dataset = ser.deserialize_array(stream)
-    norms = ser.deserialize_array(stream) if has_norms else None
+    _version, body = ser.load_stream(stream, _KIND)
+    metric = DistanceType(ser.deserialize_scalar(body, "int32"))
+    metric_arg = float(ser.deserialize_scalar(body, "float64"))
+    has_norms = bool(ser.deserialize_scalar(body, "int32"))
+    dataset = ser.deserialize_array(body)
+    norms = ser.deserialize_array(body) if has_norms else None
     return BruteForceIndex(dataset=dataset, norms=norms, metric=metric, metric_arg=metric_arg)
+
+
+def save_path(index: BruteForceIndex, path: str) -> str:
+    """Atomic (temp-then-rename) checksummed snapshot at ``path``."""
+    return ser.atomic_write(path, lambda f: save(index, f))
+
+
+def load_path(path: str, res: Optional[Resources] = None) -> BruteForceIndex:
+    with open(path, "rb") as f:
+        return load(f, res=res)
 
 
 class BatchKQuery:

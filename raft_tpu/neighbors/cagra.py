@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import io
 from typing import BinaryIO, Optional, Tuple
 
 import jax
@@ -51,6 +52,7 @@ from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import DistanceType, resolve_metric
 from raft_tpu.ops.select_k import running_merge_unique, select_k, worst_value
 from raft_tpu.random.rng import as_key
+from raft_tpu.robust import fallback as _fallback, faults as _faults
 from raft_tpu.utils.graph import reverse_edges
 
 _SUPPORTED = (
@@ -896,6 +898,7 @@ def _search_dispatch(
         expects(prefilter.size >= index.size, "prefilter smaller than index")
     filter_bits = prefilter.bits if prefilter is not None else None
 
+    requested_mode = mode
     if mode == "auto":
         mode = (
             "fused"
@@ -932,35 +935,44 @@ def _search_dispatch(
             key, kb = jax.random.split(key)
             init_ids = jax.random.randint(kb, (qc.shape[0], n_init), 0, index.size, jnp.int32)
         if mode == "fused":
-            table = _fused_table(index, params.fused_table_dtype)
-            with obs.span(
-                "cagra.search.fused_batch", nq=qc.shape[0], iters=iters, width=width
-            ) as sp:
-                v, i = sp.sync(
-                    _cagra_fused_impl(
-                        table,
-                        index.dataset,
-                        index.sqnorms,
-                        qc,
-                        init_ids,
-                        k=k,
-                        itopk=itopk,
-                        width=width,
-                        iters=iters,
-                        metric=index.metric,
-                        qt=max(8, min(params.fused_qt, -(-qc.shape[0] // 8) * 8)),
-                        interpret=jax.default_backend() != "tpu",
+            try:
+                # host-level fault point: fires per batch even when the
+                # jitted program below is cache-hit
+                _faults.fire("pallas.cagra_search", nq=int(qc.shape[0]))
+                table = _fused_table(index, params.fused_table_dtype)
+                with obs.span(
+                    "cagra.search.fused_batch", nq=qc.shape[0], iters=iters, width=width
+                ) as sp:
+                    v, i = sp.sync(
+                        _cagra_fused_impl(
+                            table,
+                            index.dataset,
+                            index.sqnorms,
+                            qc,
+                            init_ids,
+                            k=k,
+                            itopk=itopk,
+                            width=width,
+                            iters=iters,
+                            metric=index.metric,
+                            qt=max(8, min(params.fused_qt, -(-qc.shape[0] // 8) * 8)),
+                            interpret=jax.default_backend() != "tpu",
+                        )
                     )
-                )
-            if bpad:
-                v, i = v[:-bpad], i[:-bpad]
-            if obs.is_enabled():
-                obs.observe(
-                    "cagra.search.beam_occupancy", float(jnp.mean(i >= 0)), mode="fused"
-                )
-            out_v.append(v)
-            out_i.append(i)
-            continue
+                if bpad:
+                    v, i = v[:-bpad], i[:-bpad]
+                if obs.is_enabled():
+                    obs.observe(
+                        "cagra.search.beam_occupancy", float(jnp.mean(i >= 0)), mode="fused"
+                    )
+                out_v.append(v)
+                out_i.append(i)
+                continue
+            except _fallback.FALLBACK_ERRORS as e:
+                if requested_mode == "fused":
+                    raise  # the caller pinned the engine; do not mask
+                _fallback.record_fallback("cagra", e)
+                mode = "xla"  # this batch and the rest take the XLA path
         use_vpq = index.dataset is None
         vpq_arrays = None
         sqnorms = index.sqnorms
@@ -1103,8 +1115,7 @@ _KIND = "cagra"
 _VERSION = 2
 
 
-def save(index: CagraIndex, stream: BinaryIO, include_dataset: bool = True) -> None:
-    ser.dump_header(stream, _KIND, _VERSION)
+def _write_body(index: CagraIndex, stream: BinaryIO, include_dataset: bool = True) -> None:
     ser.serialize_scalar(stream, int(index.metric), "int32")
     ser.serialize_scalar(stream, int(index.size), "int64")
     has_raw = index.dataset is not None and include_dataset
@@ -1123,12 +1134,18 @@ def save(index: CagraIndex, stream: BinaryIO, include_dataset: bool = True) -> N
         ser.serialize_array(stream, index.vpq.sqnorms)
 
 
+def save(index: CagraIndex, stream: BinaryIO, include_dataset: bool = True) -> None:
+    body = io.BytesIO()
+    _write_body(index, body, include_dataset=include_dataset)
+    ser.save_stream(stream, _KIND, _VERSION, body.getvalue())
+
+
 def load(stream: BinaryIO, dataset=None, res: Optional[Resources] = None) -> CagraIndex:
     """Load an index; if it was saved without the dataset, one must be
     supplied (mirrors the reference's dataset-less serialize mode,
     ``cagra_serialize.cuh``)."""
     ensure_resources(res)
-    version = ser.check_header(stream, _KIND)
+    version, stream = ser.load_stream(stream, _KIND)
     metric = DistanceType(ser.deserialize_scalar(stream, "int32"))
     size = int(ser.deserialize_scalar(stream, "int64"))
     has_ds = bool(ser.deserialize_scalar(stream, "int32"))
@@ -1157,3 +1174,13 @@ def load(stream: BinaryIO, dataset=None, res: Optional[Resources] = None) -> Cag
     expects(data.shape[0] == size, "dataset rows != index size")
     out = from_graph(data, graph, metric)
     return dataclasses.replace(out, vpq=vpq, dim_hint=dim)
+
+
+def save_path(index: CagraIndex, path: str, include_dataset: bool = True) -> str:
+    """Atomic (temp-then-rename) checksummed snapshot at ``path``."""
+    return ser.atomic_write(path, lambda f: save(index, f, include_dataset=include_dataset))
+
+
+def load_path(path: str, dataset=None, res: Optional[Resources] = None) -> CagraIndex:
+    with open(path, "rb") as f:
+        return load(f, dataset=dataset, res=res)

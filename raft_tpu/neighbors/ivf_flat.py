@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import io
 from typing import BinaryIO, Optional, Tuple
 
 import jax
@@ -764,8 +765,7 @@ _KIND = "ivf_flat"
 _VERSION = 3
 
 
-def save(index: IvfFlatIndex, stream: BinaryIO) -> None:
-    ser.dump_header(stream, _KIND, _VERSION)
+def _write_body(index: IvfFlatIndex, stream: BinaryIO) -> None:
     ser.serialize_scalar(stream, int(index.metric), "int32")
     ser.serialize_scalar(stream, int(index.size), "int64")
     ser.serialize_scalar(stream, float(index.list_cap_factor), "float64")
@@ -781,9 +781,15 @@ def save(index: IvfFlatIndex, stream: BinaryIO) -> None:
         ser.serialize_array(stream, index.center_rank)
 
 
+def save(index: IvfFlatIndex, stream: BinaryIO) -> None:
+    body = io.BytesIO()
+    _write_body(index, body)
+    ser.save_stream(stream, _KIND, _VERSION, body.getvalue())
+
+
 def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfFlatIndex:
     ensure_resources(res)
-    version = ser.check_header(stream, _KIND)
+    version, stream = ser.load_stream(stream, _KIND)
     metric = DistanceType(ser.deserialize_scalar(stream, "int32"))
     size = int(ser.deserialize_scalar(stream, "int64"))
     cap_factor = float(ser.deserialize_scalar(stream, "float64")) if version >= 2 else 2.0
@@ -806,3 +812,13 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfFlatIndex:
         list_cap_factor=cap_factor,
         center_rank=center_rank,
     )
+
+
+def save_path(index: IvfFlatIndex, path: str) -> str:
+    """Atomic (temp-then-rename) checksummed snapshot at ``path``."""
+    return ser.atomic_write(path, lambda f: save(index, f))
+
+
+def load_path(path: str, res: Optional[Resources] = None) -> IvfFlatIndex:
+    with open(path, "rb") as f:
+        return load(f, res=res)

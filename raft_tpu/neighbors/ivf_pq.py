@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import io
 import warnings
 from typing import BinaryIO, Optional, Tuple
 
@@ -73,6 +74,7 @@ from raft_tpu.ops.distance import DistanceType, resolve_metric
 from raft_tpu.ops.fused_1nn import min_cluster_and_distance
 from raft_tpu.ops.select_k import running_merge, select_k, worst_value
 from raft_tpu.random.rng import as_key
+from raft_tpu.robust import fallback as _fallback, faults as _faults
 from raft_tpu.utils.math import round_up
 
 _SUPPORTED = (
@@ -1229,6 +1231,7 @@ def _search_dispatch(
         params.lut_dtype is not None
         and jnp.dtype(params.lut_dtype) == jnp.dtype(jnp.float32)
     )
+    requested_mode = mode
     if mode == "auto":
         if nq >= 128 and jax.default_backend() == "tpu" and fused_ok and not wants_f32_lut:
             mode = "fused"
@@ -1329,8 +1332,19 @@ def _search_dispatch(
 
         from raft_tpu.neighbors.ivf_flat import _batched_search
 
-        with obs.span("ivf_pq.search.fused", nq=nq, k=k, n_probes=n_probes) as sp:
-            return sp.sync(_batched_search(run_fused, queries, query_batch))
+        try:
+            # host-level fault point: fires even when the jitted kernel
+            # program below is cache-hit
+            _faults.fire("pallas.pq_scan", nq=int(nq))
+            with obs.span("ivf_pq.search.fused", nq=nq, k=k, n_probes=n_probes) as sp:
+                # sync inside the try: runtime kernel failures surface at
+                # block_until_ready and must reach the fallback handler
+                return sp.sync(_batched_search(run_fused, queries, query_batch))
+        except _fallback.FALLBACK_ERRORS as e:
+            if requested_mode == "fused":
+                raise  # the caller pinned the engine; do not mask
+            _fallback.record_fallback("ivf_pq", e)
+            mode = "scan"  # identical candidate set, decode-scan engine
 
     if mode == "scan":
         g = scan_chunk_lists(index.n_lists, index.max_list)
@@ -1421,8 +1435,7 @@ _KIND = "ivf_pq"
 _VERSION = 3
 
 
-def save(index: IvfPqIndex, stream: BinaryIO) -> None:
-    ser.dump_header(stream, _KIND, _VERSION)
+def _write_body(index: IvfPqIndex, stream: BinaryIO) -> None:
     ser.serialize_scalar(stream, int(index.metric), "int32")
     ser.serialize_scalar(stream, int(index.size), "int64")
     ser.serialize_scalar(stream, int(index.pq_bits), "int32")
@@ -1443,9 +1456,15 @@ def save(index: IvfPqIndex, stream: BinaryIO) -> None:
         ser.serialize_array(stream, index.center_rank)
 
 
+def save(index: IvfPqIndex, stream: BinaryIO) -> None:
+    body = io.BytesIO()
+    _write_body(index, body)
+    ser.save_stream(stream, _KIND, _VERSION, body.getvalue())
+
+
 def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfPqIndex:
     ensure_resources(res)
-    version = ser.check_header(stream, _KIND)
+    version, stream = ser.load_stream(stream, _KIND)
     metric = DistanceType(ser.deserialize_scalar(stream, "int32"))
     size = int(ser.deserialize_scalar(stream, "int64"))
     pq_bits = int(ser.deserialize_scalar(stream, "int32"))
@@ -1487,3 +1506,13 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfPqIndex:
         packed=packed,
         center_rank=center_rank,
     )
+
+
+def save_path(index: IvfPqIndex, path: str) -> str:
+    """Atomic (temp-then-rename) checksummed snapshot at ``path``."""
+    return ser.atomic_write(path, lambda f: save(index, f))
+
+
+def load_path(path: str, res: Optional[Resources] = None) -> IvfPqIndex:
+    with open(path, "rb") as f:
+        return load(f, res=res)

@@ -101,7 +101,7 @@ def span(name: str, **args) -> Iterator[Any]:
 
             try:
                 jax.block_until_ready(s._sync)
-            except Exception:  # noqa: BLE001 — timing must never mask the real error
+            except Exception:  # graft-lint: ignore[silent-except] — timing must never mask the real error
                 pass
         dur = (time.perf_counter() - t0) * 1e6
         if st and st[-1] is s:

@@ -1,0 +1,30 @@
+"""Typed failure boundary for the fused Pallas entry points.
+
+Lowering/compile failures escape ``pallas_call`` as whatever
+jax/jaxlib/mosaic type the toolchain produced that release; the serving
+dispatch needs ONE type to key its fused→XLA fallback on. This guard
+translates toolchain-originated exceptions into
+:class:`raft_tpu.core.errors.KernelFailure` (chaining the original) while
+letting library errors (``RaftError``) and plain caller bugs through
+untouched.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from raft_tpu.core.errors import KernelFailure, RaftError
+
+
+@contextlib.contextmanager
+def kernel_guard(name: str):
+    """Translate jax/jaxlib-originated failures in the block into
+    :class:`KernelFailure` (``__cause__`` keeps the original)."""
+    try:
+        yield
+    except RaftError:
+        raise
+    except Exception as e:
+        mod = type(e).__module__ or ""
+        if mod.split(".")[0] in ("jax", "jaxlib", "mlir"):
+            raise KernelFailure(f"{name}: {type(e).__name__}: {e}") from e
+        raise

@@ -322,13 +322,16 @@ def cagra_fused_search(
         _beam_kernel,
         itopk=itopk, width=width, deg=deg, d=d, qt=qt, iters=iters, ip=ip,
     )
-    out_v, out_idf = pl.pallas_call(
-        kern,
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((nqp, itopk), jnp.float32),
-            jax.ShapeDtypeStruct((nqp, itopk), jnp.int32),
-        ],
-        interpret=interpret,
-    )(queries, init_v, init_idf, table)
+    from raft_tpu.ops.pallas._guard import kernel_guard
+
+    with kernel_guard("cagra_fused_search"):
+        out_v, out_idf = pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((nqp, itopk), jnp.float32),
+                jax.ShapeDtypeStruct((nqp, itopk), jnp.int32),
+            ],
+            interpret=interpret,
+        )(queries, init_v, init_idf, table)
     return out_v[:nq], out_idf[:nq]

@@ -477,25 +477,28 @@ def ivf_pq_fused_search(
     else:
         ln = jnp.where(valid, rot_sqnorms, jnp.inf)
 
+    from raft_tpu.ops.pallas._guard import kernel_guard
+
     gm = group * m
-    vals, slots = fused_pq_topk(
-        codes.reshape(n_units, gm, bpr),
-        ln.reshape(n_units, 1, gm),
-        w,
-        q_rot,
-        centers_rot.reshape(n_units, group, rot_dim),
-        tile_probes,
-        probe_valid,
-        k=k,
-        metric=metric,
-        qt=qt,
-        merge=merge,
-        code_mode=code_mode,
-        ksub=ksub,
-        extract_every=extract_every,
-        decode_cols=decode_cols,
-        interpret=interpret,
-    )
+    with kernel_guard("ivf_pq_fused_search"):
+        vals, slots = fused_pq_topk(
+            codes.reshape(n_units, gm, bpr),
+            ln.reshape(n_units, 1, gm),
+            w,
+            q_rot,
+            centers_rot.reshape(n_units, group, rot_dim),
+            tile_probes,
+            probe_valid,
+            k=k,
+            metric=metric,
+            qt=qt,
+            merge=merge,
+            code_mode=code_mode,
+            ksub=ksub,
+            extract_every=extract_every,
+            decode_cols=decode_cols,
+            interpret=interpret,
+        )
 
     # postprocess (mirrors _ivf_pq_scan_impl's tail)
     flat_ids = list_indices.reshape(-1)

@@ -27,7 +27,7 @@ try:
         sharded_ivf_pq_search,
     )
     from raft_tpu.parallel.sharded_knn import sharded_knn
-except ImportError:
+except ImportError:  # graft-lint: ignore[silent-except] — availability probe
     # sharded_* need jax.shard_map (jax >= 0.5). Keep the comms verb set
     # importable on older jax; the sharded names stay UNDEFINED so
     # `from raft_tpu.parallel import sharded_knn` still raises ImportError

@@ -1,0 +1,26 @@
+"""jax version compatibility for the distributed layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` (kwarg
+``check_rep``) to top-level ``jax.shard_map`` (kwarg ``check_vma``); this
+shim exposes one signature — the modern one — and translates for older
+runtimes, so the sharded search paths (and the degraded-mode chaos suite)
+run identically on jax 0.4.x CPU test meshes and current TPU releases.
+"""
+from __future__ import annotations
+
+try:  # jax >= 0.5: top-level export, replication check renamed check_vma
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, kwarg check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """Version-portable ``shard_map`` (modern keyword signature)."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

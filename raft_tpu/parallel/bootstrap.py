@@ -33,14 +33,29 @@ import numpy as np
 
 from raft_tpu.core.logging import info, warn
 from raft_tpu.parallel import comms as comms_mod
+from raft_tpu.robust import faults
+from raft_tpu.robust.retry import RetryPolicy, retry_call
 
 _initialized = False
+
+#: coordinator bootstrap races its peers — transient connection errors are
+#: the norm, so retry them (raft-dask's Comms.init polls the same way)
+DEFAULT_INIT_RETRY = RetryPolicy(
+    max_attempts=4, base_delay_s=0.2, multiplier=2.0, max_delay_s=5.0,
+    retryable=(ConnectionError, TimeoutError, OSError, RuntimeError),
+)
+
+
+class _AlreadyInitialized(Exception):
+    """Internal marker: the launcher beat us to ``jax.distributed`` —
+    success, not a retryable failure."""
 
 
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    retry_policy: Optional[RetryPolicy] = DEFAULT_INIT_RETRY,
 ) -> bool:
     """Initialize the multi-host runtime (``Comms.init`` analog,
     ``raft_dask/common/comms.py:172``).
@@ -48,20 +63,31 @@ def init_distributed(
     With no arguments on a single host this is a no-op returning False
     (local devices already visible); on a pod each host passes the shared
     coordinator address and its rank, and all hosts' devices become
-    globally addressable. Safe to call more than once.
+    globally addressable. Safe to call more than once. Transient
+    coordinator failures are retried per ``retry_policy`` (pass ``None``
+    to fail fast).
     """
     global _initialized
     if _initialized:
         return True
-    if coordinator_address is None and jax.process_count() == 1:
-        # single-host degenerate path: nothing to bootstrap
-        return False
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+
+    def _attempt() -> bool:
+        global _initialized
+        faults.fire("bootstrap.init", coordinator=coordinator_address)
+        if coordinator_address is None and jax.process_count() == 1:
+            # single-host degenerate path: nothing to bootstrap
+            return False
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as e:  # already initialized by the launcher
+            msg = str(e).lower()
+            if "already initialized" in msg or "should only be called once" in msg:
+                raise _AlreadyInitialized from e
+            raise
         _initialized = True
         info(
             "raft_tpu.parallel.bootstrap: process %d/%d, %d global devices",
@@ -70,12 +96,14 @@ def init_distributed(
             len(jax.devices()),
         )
         return True
-    except RuntimeError as e:  # already initialized by the launcher
-        msg = str(e).lower()
-        if "already initialized" in msg or "should only be called once" in msg:
-            _initialized = True
-            return True
-        raise
+
+    try:
+        if retry_policy is None:
+            return _attempt()
+        return retry_call(_attempt, policy=retry_policy, op="bootstrap.init")
+    except _AlreadyInitialized:
+        _initialized = True
+        return True
 
 
 def shutdown() -> None:
@@ -106,8 +134,9 @@ def run_comms_self_test(mesh=None, axis: str = comms_mod.DEFAULT_AXIS) -> bool:
     ``test_collective_allreduce`` analog), runnable per host after
     bootstrap. Exercises allreduce / allgather / bcast / ppermute /
     barrier over the mesh; returns True when every verb round-trips."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.parallel._compat import shard_map
 
     if mesh is None:
         mesh = global_mesh()

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu import obs
 from raft_tpu.core.errors import expects
 from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.robust import faults
 
 DEFAULT_AXIS = "data"
 
@@ -166,6 +167,10 @@ def allgather(x, axis: str = DEFAULT_AXIS, tiled: bool = False):
     """``comms_t::allgather`` — concatenate per-rank blocks along axis 0
     (``core/comms.hpp:330``). With ``tiled=False`` a new leading rank axis is
     stacked; with ``tiled=True`` blocks are concatenated along axis 0."""
+    # fault point fires at trace time (verbs run while shard_map traces);
+    # an injected failure here aborts program construction, the collective
+    # analog of a lost participant
+    faults.fire("comms.all_gather", axis=str(axis))
     return lax.all_gather(x, axis, tiled=tiled)
 
 

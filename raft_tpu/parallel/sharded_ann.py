@@ -33,7 +33,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import functools
@@ -41,16 +41,28 @@ import functools
 from raft_tpu.core.errors import expects
 from raft_tpu.neighbors import cagra as cagra_mod, ivf_flat as ivf_flat_mod, ivf_pq as ivf_pq_mod
 from raft_tpu.ops.distance import DistanceType
-from raft_tpu.ops.select_k import merge_parts
+from raft_tpu.ops.select_k import merge_parts, worst_value
+from raft_tpu.parallel._compat import shard_map
 from raft_tpu.random.rng import as_key
 
 
-@functools.lru_cache(maxsize=64)
-def _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local):
-    """Cached jitted shard_map program (rebuilding it per call would
-    re-trace and recompile every search)."""
+def _health_array(health, n_shards) -> jnp.ndarray:
+    """Replicated per-shard health mask [n_shards] bool; ``None`` means
+    all healthy (and callers then build the unmasked program)."""
+    h = jnp.asarray(health, bool)
+    expects(h.shape == (n_shards,), "health mask shape %s != (%d,)", h.shape, n_shards)
+    return h
 
-    def local(centers, ld, li, ln, q):
+
+@functools.lru_cache(maxsize=64)
+def _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local, masked=False):
+    """Cached jitted shard_map program (rebuilding it per call would
+    re-trace and recompile every search). With ``masked=True`` the program
+    takes an extra replicated ``healthy [n_shards]`` input and unhealthy
+    shards' candidates are demoted to worst-value/-1 before the gather, so
+    the k-way merge drops them (degraded-mode search)."""
+
+    def local(centers, ld, li, ln, q, *rest):
         rank = lax.axis_index(axis)
         qf = q
         if metric == DistanceType.CosineExpanded:
@@ -61,20 +73,26 @@ def _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local):
             ld, li, ln, qf, probed_local, None,
             k=k, metric=metric, has_filter=False, chunk_lists=g,
         )
+        select_min = metric != DistanceType.InnerProduct
+        if masked:
+            (healthy,) = rest
+            ok = healthy[rank]
+            v = jnp.where(ok, v, worst_value(v.dtype, select_min))
+            i = jnp.where(ok, i, -1)
         all_v = jax.lax.all_gather(v, axis)
         all_i = jax.lax.all_gather(i, axis)
         nq = q.shape[0]
         cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
         cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
-        select_min = metric != DistanceType.InnerProduct
         # invalid (-1) slots carry +/-inf values and lose the merge
         return merge_parts(cat_v, cat_i, k, select_min=select_min)
 
+    extra = (P(),) if masked else ()
     return jax.jit(
         shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(), P(axis), P(axis), P(axis), P()),
+            in_specs=(P(), P(axis), P(axis), P(axis), P()) + extra,
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -88,12 +106,15 @@ def sharded_ivf_flat_search(
     k: int,
     params: Optional["ivf_flat_mod.IvfFlatSearchParams"] = None,
     axis: str = "data",
+    health=None,
     **kwargs,
 ) -> Tuple[jax.Array, jax.Array]:
     """IVF-Flat search with lists sharded over ``mesh`` axis ``axis``.
 
     Returns replicated ``(distances [nq, k], indices [nq, k])`` drawn from
-    the same probed candidate set as single-device scan search.
+    the same probed candidate set as single-device scan search. With a
+    per-shard boolean ``health`` mask, unhealthy shards are excluded from
+    the merge (degraded-mode search; see :mod:`raft_tpu.robust.degrade`).
     """
     if params is None:
         params = ivf_flat_mod.IvfFlatSearchParams(**kwargs)
@@ -106,18 +127,22 @@ def sharded_ivf_flat_search(
     metric = index.metric
     g = ivf_flat_mod.scan_chunk_lists(l_local, index.max_list)
 
-    fn = _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local)
+    masked = health is not None
+    fn = _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local, masked)
     ln = index.list_norms
     if ln is None:
         ln = jnp.zeros(index.list_indices.shape, jnp.float32)
     put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
-    return fn(
+    args = [
         put(index.centers, P()),
         put(index.list_data, P(axis)),
         put(index.list_indices, P(axis)),
         put(ln, P(axis)),
         put(queries, P()),
-    )
+    ]
+    if masked:
+        args.append(put(_health_array(health, n_shards), P()))
+    return fn(*args)
 
 
 @functools.lru_cache(maxsize=64)
@@ -200,11 +225,13 @@ def sharded_cagra_search(
 
 
 @functools.lru_cache(maxsize=64)
-def _ivf_pq_lists_fn(mesh, axis, k, n_probes, metric, g, bf16, l_local):
+def _ivf_pq_lists_fn(mesh, axis, k, n_probes, metric, g, bf16, l_local, masked=False):
     """Lists-sharded PQ search program: replicated centers/quantizers,
-    per-shard decode scan over the local list slice, allgather + merge."""
+    per-shard decode scan over the local list slice, allgather + merge.
+    ``masked=True`` adds the replicated per-shard health input (see
+    :func:`_ivf_flat_fn`)."""
 
-    def local(centers, centers_rot, rotation, pq_centers, codes, li, sqn, q):
+    def local(centers, centers_rot, rotation, pq_centers, codes, li, sqn, q, *rest):
         rank = lax.axis_index(axis)
         qf = q.astype(jnp.float32)
         q_dot_c = qf @ centers.T
@@ -231,18 +258,24 @@ def _ivf_pq_lists_fn(mesh, axis, k, n_probes, metric, g, bf16, l_local):
             k=k, metric=metric, per_cluster=False, has_filter=False,
             chunk_lists=g, bf16=bf16,
         )
+        select_min = metric != DistanceType.InnerProduct
+        if masked:
+            (healthy,) = rest
+            ok = healthy[rank]
+            v = jnp.where(ok, v, worst_value(v.dtype, select_min))
+            i = jnp.where(ok, i, -1)
         all_v = jax.lax.all_gather(v, axis)
         all_i = jax.lax.all_gather(i, axis)
         cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
         cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
-        select_min = metric != DistanceType.InnerProduct
         return merge_parts(cat_v, cat_i, k, select_min=select_min)
 
+    extra = (P(),) if masked else ()
     return jax.jit(
         shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P(), P(axis), P(axis), P(axis), P()),
+            in_specs=(P(), P(), P(), P(), P(axis), P(axis), P(axis), P()) + extra,
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -256,13 +289,15 @@ def sharded_ivf_pq_lists_search(
     k: int,
     params: Optional["ivf_pq_mod.IvfPqSearchParams"] = None,
     axis: str = "data",
+    health=None,
     **kwargs,
 ) -> Tuple[jax.Array, jax.Array]:
     """IVF-PQ search with the CODE LISTS sharded over ``mesh`` axis
     ``axis`` (replicated coarse centers + codebooks). Per-shard HBM holds
     ``1/n_shards`` of the codes — the scaling mode for datasets beyond one
     chip (SURVEY §7 step 7). Returns replicated ``(distances, indices)``
-    from the same probed candidate set as single-device scan search."""
+    from the same probed candidate set as single-device scan search.
+    ``health`` (per-shard bools) excludes failed shards from the merge."""
     if params is None:
         params = ivf_pq_mod.IvfPqSearchParams(**kwargs)
     expects(
@@ -278,9 +313,10 @@ def sharded_ivf_pq_lists_search(
     g = ivf_pq_mod.scan_chunk_lists(l_local, index.max_list)
     bf16 = ivf_pq_mod.scan_bf16(params.lut_dtype)
 
-    fn = _ivf_pq_lists_fn(mesh, axis, k, n_probes, index.metric, g, bf16, l_local)
+    masked = health is not None
+    fn = _ivf_pq_lists_fn(mesh, axis, k, n_probes, index.metric, g, bf16, l_local, masked)
     put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
-    return fn(
+    args = [
         put(index.centers, P()),
         put(index.centers_rot, P()),
         put(index.rotation, P()),
@@ -289,7 +325,10 @@ def sharded_ivf_pq_lists_search(
         put(index.list_indices, P(axis)),
         put(index.rot_sqnorms, P(axis)),
         put(queries, P()),
-    )
+    ]
+    if masked:
+        args.append(put(_health_array(health, n_shards), P()))
+    return fn(*args)
 
 
 def sharded_ivf_pq_build(

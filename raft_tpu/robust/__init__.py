@@ -1,0 +1,53 @@
+"""raft_tpu.robust — fault tolerance for the serving stack.
+
+Four pieces, built on the PR-3 observability layer so every degradation
+is visible:
+
+* :mod:`raft_tpu.robust.faults` — deterministic fault-injection registry
+  (env gate ``RAFT_TPU_FAULTS``, named points at the real seams, trigger
+  policies, typed errors, latency injection).
+* :mod:`raft_tpu.robust.retry` — ``RetryPolicy`` with exponential backoff
+  + seeded jitter for idempotent control-plane work (bootstrap, native
+  compile, dataset download).
+* :mod:`raft_tpu.robust.degrade` — shard-failure-tolerant sharded search
+  with coverage reporting.
+* :mod:`raft_tpu.robust.fallback` — fused-kernel → XLA fallback policy
+  used by ``mode="auto"`` dispatch.
+
+See ``docs/robustness.md``.
+"""
+from raft_tpu.robust import faults
+from raft_tpu.robust.degrade import (
+    DegradedResult,
+    probe_shard_health,
+    sharded_search_degraded,
+)
+from raft_tpu.robust.fallback import (
+    FALLBACK_ERRORS,
+    fallback_errors,
+    record_fallback,
+    reset_warned,
+)
+from raft_tpu.robust.retry import (
+    DEFAULT_POLICY,
+    RetryError,
+    RetryPolicy,
+    retry_call,
+    retrying,
+)
+
+__all__ = [
+    "DEFAULT_POLICY",
+    "DegradedResult",
+    "FALLBACK_ERRORS",
+    "RetryError",
+    "RetryPolicy",
+    "fallback_errors",
+    "faults",
+    "probe_shard_health",
+    "record_fallback",
+    "reset_warned",
+    "retry_call",
+    "retrying",
+    "sharded_search_degraded",
+]

@@ -1,0 +1,133 @@
+"""Degraded-mode sharded search: lose a shard, keep serving.
+
+Lists-sharded search (IVF-Flat / IVF-PQ) holds ``1/n_shards`` of the
+index per device; a lost shard removes that slice of the candidate pool
+but the remaining shards still cover ``(n-1)/n`` of the lists. Production
+ANN serving degrades coverage instead of failing the query (FusionANNS
+treats SSD-tier misses the same way); this module is that policy:
+
+* per-shard health is probed through the ``sharded_ann.shard_scan``
+  fault point (the chaos hook; a real deployment would wire device-health
+  callbacks into the same mask),
+* failed shards are excluded from the all_gather + k-way merge via the
+  ``health`` mask on :func:`raft_tpu.parallel.sharded_ann.sharded_ivf_flat_search`
+  / ``sharded_ivf_pq_lists_search`` (their merge already drops
+  worst-value/-1 slots),
+* results carry a ``coverage`` fraction and ``degraded`` flag, and the
+  event is visible in ``obs`` (``robust.degraded_queries``,
+  ``robust.shard_failures{algo}``, gauge ``robust.shards_healthy``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+
+from raft_tpu import obs
+from raft_tpu.core.errors import ShardFailure, expects
+from raft_tpu.robust import faults
+
+_ALGOS = ("ivf_flat", "ivf_pq_lists")
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradedResult:
+    """Search output + the health picture it was computed under."""
+
+    distances: jax.Array  # [nq, k]
+    indices: jax.Array  # [nq, k]
+    #: fraction of shards (== fraction of inverted lists) that answered
+    coverage: float
+    degraded: bool
+    failed_shards: Tuple[int, ...]
+
+    def __iter__(self):  # unpack like the non-degraded (distances, indices)
+        return iter((self.distances, self.indices))
+
+
+def probe_shard_health(
+    mesh, axis: str = "data", algo: str = "ivf_flat"
+) -> Tuple[bool, ...]:
+    """Per-shard health mask for ``mesh`` axis ``axis``.
+
+    Each shard is probed through the ``sharded_ann.shard_scan`` fault
+    point; a :class:`ShardFailure` (injected by the chaos registry, or
+    raised by a real health callback installed at the same point) marks
+    that shard unhealthy. All-healthy is the no-injection fast path.
+    """
+    n_shards = mesh.shape[axis]
+    health = []
+    for s in range(n_shards):
+        try:
+            faults.fire("sharded_ann.shard_scan", shard=s, algo=algo, axis=axis)
+            health.append(True)
+        except ShardFailure:
+            obs.inc("robust.shard_failures", algo=algo, shard=str(s))
+            health.append(False)
+    return tuple(health)
+
+
+def sharded_search_degraded(
+    mesh,
+    index,
+    queries,
+    k: int,
+    *,
+    algo: str = "ivf_flat",
+    params=None,
+    axis: str = "data",
+    health: Optional[Sequence[bool]] = None,
+    min_coverage: float = 0.0,
+    **kwargs,
+) -> DegradedResult:
+    """Lists-sharded search that tolerates failed shards.
+
+    ``algo`` picks the sharding ("ivf_flat" or "ivf_pq_lists"); ``health``
+    overrides probing (``None`` → probe via the fault point). Raises
+    :class:`ShardFailure` only when no shard is healthy or coverage falls
+    below ``min_coverage`` — otherwise returns a :class:`DegradedResult`
+    whose candidates come from the surviving shards only.
+    """
+    from raft_tpu.parallel import sharded_ann
+
+    expects(algo in _ALGOS, "unknown degraded-search algo %r (want one of %s)",
+            algo, _ALGOS)
+    n_shards = mesh.shape[axis]
+    if health is None:
+        health = probe_shard_health(mesh, axis, algo)
+    health = tuple(bool(h) for h in health)
+    expects(len(health) == n_shards, "health mask has %d entries for %d shards",
+            len(health), n_shards)
+
+    n_healthy = sum(health)
+    coverage = n_healthy / n_shards
+    failed = tuple(s for s, ok in enumerate(health) if not ok)
+    if n_healthy == 0:
+        obs.inc("robust.queries_failed", algo=algo)
+        raise ShardFailure(f"all {n_shards} shards unhealthy", shard=-1)
+    if coverage < min_coverage:
+        obs.inc("robust.queries_failed", algo=algo)
+        raise ShardFailure(
+            f"coverage {coverage:.2f} below required {min_coverage:.2f} "
+            f"(failed shards: {failed})", shard=failed[0],
+        )
+
+    degraded = n_healthy < n_shards
+    obs.set_gauge("robust.shards_healthy", n_healthy, algo=algo)
+    if degraded:
+        obs.inc("robust.degraded_queries", algo=algo)
+
+    search = (
+        sharded_ann.sharded_ivf_flat_search if algo == "ivf_flat"
+        else sharded_ann.sharded_ivf_pq_lists_search
+    )
+    # all-healthy uses the unmasked (pre-existing, bit-identical) program
+    d, i = search(
+        mesh, index, queries, k, params=params, axis=axis,
+        health=health if degraded else None, **kwargs,
+    )
+    return DegradedResult(
+        distances=d, indices=i, coverage=coverage,
+        degraded=degraded, failed_shards=failed,
+    )

@@ -1,0 +1,242 @@
+"""Deterministic fault injection for the serving stack.
+
+Production billion-scale ANN systems treat partial failure as a
+first-class design axis (FusionANNS; Faiss at billion scale); this module
+is the chaos-engineering half of that story: named **fault points** are
+compiled into the real seams of the query path and fire typed errors (or
+injected latency) under test control.
+
+Mirrors the :mod:`raft_tpu.obs.metrics` design exactly: one process-wide
+gate (env ``RAFT_TPU_FAULTS``, **default off**), and the disabled path
+allocates nothing — :func:`fire` checks the module flag and returns
+before touching the registry, so instrumented call sites cost one
+attribute load + branch when injection is off.
+
+Fault points live at HOST level, never inside jitted/traced code: a raise
+during tracing would only fire on the first trace and then be baked out
+of (or poison) the compiled cache. Every registered point sits on the
+Python side of a dispatch boundary.
+
+Usage::
+
+    from raft_tpu.robust import faults
+    faults.enable()
+    faults.install("sharded_ann.shard_scan",
+                   error=ShardFailure("chaos", shard=2),
+                   match={"shard": 2})
+    ...  # next sharded search sees shard 2 fail
+    faults.clear()
+
+Trigger policies: ``always`` (default), ``nth=i`` (exactly the i-th
+matching call, 0-based), ``first_n=n`` (the first n matching calls — a
+transient fault window, what retry tests want), ``probability=p`` with a
+seeded PRNG (deterministic chaos). ``latency_s`` sleeps instead of (or
+before) raising. Every firing is counted in ``obs``
+(``faults.fired{point,kind}``) so degradations stay visible.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from raft_tpu import obs
+from raft_tpu.core.errors import expects
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+_enabled = os.environ.get("RAFT_TPU_FAULTS", "0").strip().lower() in _TRUTHY
+
+
+def enable(flag: bool = True) -> None:
+    """Turn fault injection on/off process-wide (``RAFT_TPU_FAULTS`` analog)."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def disable() -> None:
+    enable(False)
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+#: the named seams fault specs may attach to — each corresponds to one
+#: host-level ``fire(...)`` call in the serving stack
+FAULT_POINTS = (
+    "comms.all_gather",       # parallel/comms.py allgather verb (trace time)
+    "sharded_ann.shard_scan", # robust/degrade.py per-shard health probe
+    "pallas.cagra_search",    # neighbors/cagra.py fused dispatch branch
+    "pallas.pq_scan",         # neighbors/ivf_pq.py fused dispatch branch
+    "serialize.load",         # core/serialize.py load_stream
+    "bootstrap.init",         # parallel/bootstrap.py init_distributed attempt
+)
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One installed fault: where it fires, what it raises, and when."""
+
+    point: str
+    error: Optional[BaseException] = None
+    latency_s: float = 0.0
+    trigger: str = "always"  # "always" | "nth" | "first_n" | "probability"
+    nth: int = 0
+    first_n: int = 1
+    probability: float = 1.0
+    seed: int = 0
+    match: Optional[Dict[str, object]] = None
+    #: calls that matched this spec's point+context so far
+    calls: int = 0
+    #: times this spec actually fired (raised or slept)
+    fired: int = 0
+    _rng: Optional[random.Random] = None
+
+    def _matches(self, ctx: Dict[str, object]) -> bool:
+        if not self.match:
+            return True
+        return all(ctx.get(k) == v for k, v in self.match.items())
+
+    def _should_fire(self) -> bool:
+        if self.trigger == "always":
+            return True
+        if self.trigger == "nth":
+            return self.calls - 1 == self.nth
+        if self.trigger == "first_n":
+            return self.calls <= self.first_n
+        if self.trigger == "probability":
+            if self._rng is None:
+                self._rng = random.Random(self.seed)
+            return self._rng.random() < self.probability
+        return False
+
+
+class FaultRegistry:
+    """Thread-safe store of installed :class:`FaultSpec` s."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._specs: List[FaultSpec] = []
+
+    def install(self, spec: FaultSpec) -> FaultSpec:
+        expects(
+            spec.point in FAULT_POINTS, "unknown fault point %r (known: %s)",
+            spec.point, ", ".join(FAULT_POINTS),
+        )
+        expects(spec.trigger in ("always", "nth", "first_n", "probability"),
+                "unknown trigger %r", spec.trigger)
+        with self._lock:
+            self._specs.append(spec)
+        return spec
+
+    def remove(self, spec: FaultSpec) -> None:
+        with self._lock:
+            if spec in self._specs:
+                self._specs.remove(spec)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs.clear()
+
+    def specs(self, point: Optional[str] = None) -> List[FaultSpec]:
+        with self._lock:
+            snap = list(self._specs)
+        if point is None:
+            return snap
+        return [s for s in snap if s.point == point]
+
+    def fire(self, point: str, **ctx) -> None:
+        """Evaluate every spec installed at ``point`` against ``ctx``;
+        sleep/raise per the first spec whose trigger fires."""
+        with self._lock:
+            specs = [s for s in self._specs if s.point == point]
+        for spec in specs:
+            with self._lock:
+                if not spec._matches(ctx):
+                    continue
+                spec.calls += 1
+                should = spec._should_fire()
+                if should:
+                    spec.fired += 1
+            if not should:
+                continue
+            kind = type(spec.error).__name__ if spec.error is not None else "latency"
+            obs.inc("faults.fired", point=point, kind=kind)
+            if spec.latency_s > 0.0:
+                time.sleep(spec.latency_s)
+            if spec.error is not None:
+                raise spec.error
+
+
+_default = FaultRegistry()
+
+
+def registry() -> FaultRegistry:
+    """The process-wide default fault registry."""
+    return _default
+
+
+def install(
+    point: str,
+    error: Optional[BaseException] = None,
+    *,
+    latency_s: float = 0.0,
+    trigger: str = "always",
+    nth: int = 0,
+    first_n: int = 1,
+    probability: float = 1.0,
+    seed: int = 0,
+    match: Optional[Dict[str, object]] = None,
+) -> FaultSpec:
+    """Install a fault at ``point`` in the default registry."""
+    return _default.install(FaultSpec(
+        point=point, error=error, latency_s=latency_s, trigger=trigger,
+        nth=nth, first_n=first_n, probability=probability, seed=seed,
+        match=dict(match) if match else None,
+    ))
+
+
+def remove(spec: FaultSpec) -> None:
+    _default.remove(spec)
+
+
+def clear() -> None:
+    _default.clear()
+
+
+def fire(point: str, **ctx) -> None:
+    """The call sites' hook: no-op (one branch) unless injection is
+    enabled AND a matching spec's trigger fires."""
+    if not _enabled:
+        return
+    _default.fire(point, **ctx)
+
+
+class injected:
+    """Context manager for tests: enable injection, install one fault,
+    restore the previous state on exit::
+
+        with faults.injected("pallas.cagra_search", error=KernelFailure("x")):
+            ...
+    """
+
+    def __init__(self, point: str, error: Optional[BaseException] = None, **kw):
+        self._point, self._error, self._kw = point, error, kw
+        self._spec: Optional[FaultSpec] = None
+        self._was_enabled = False
+
+    def __enter__(self) -> FaultSpec:
+        self._was_enabled = is_enabled()
+        enable()
+        self._spec = install(self._point, self._error, **self._kw)
+        return self._spec
+
+    def __exit__(self, *exc):
+        if self._spec is not None:
+            remove(self._spec)
+        enable(self._was_enabled)
+        return False

@@ -1,0 +1,124 @@
+"""Retry with exponential backoff + seeded jitter.
+
+Transient-failure policy for the control plane: multi-host bootstrap
+(``jax.distributed.initialize`` races its coordinator), native compiles
+(fs/toolchain hiccups), and dataset downloads. The schedule is fully
+deterministic given ``(policy, seed)`` so tests can assert the exact
+delay sequence — jitter comes from ``random.Random(seed)``, never from
+wall-clock entropy.
+
+The hot query path never retries (a failed kernel falls back, a failed
+shard degrades — see :mod:`raft_tpu.robust.degrade`); retry is for
+idempotent setup work where "try again in a moment" is the right answer.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from raft_tpu import obs
+from raft_tpu.core.errors import expects
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff policy: delay before attempt ``i+1`` is
+    ``min(base_delay_s * multiplier**i, max_delay_s)`` scaled by a seeded
+    jitter factor drawn uniformly from ``[1 - jitter_frac, 1 + jitter_frac]``."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 5.0
+    jitter_frac: float = 0.1
+    #: overall wall-clock budget; ``None`` means attempts-only
+    deadline_s: Optional[float] = None
+    retryable: Tuple[Type[BaseException], ...] = (Exception,)
+
+    def schedule(self, seed: int = 0) -> Tuple[float, ...]:
+        """The deterministic delay sequence (one entry per retry, i.e.
+        ``max_attempts - 1`` entries) for ``seed``."""
+        rng = random.Random(seed)
+        out = []
+        for i in range(max(self.max_attempts - 1, 0)):
+            base = min(self.base_delay_s * self.multiplier ** i, self.max_delay_s)
+            lo, hi = 1.0 - self.jitter_frac, 1.0 + self.jitter_frac
+            out.append(base * rng.uniform(lo, hi))
+        return tuple(out)
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted (or deadline exceeded); ``__cause__`` is the
+    last underlying failure."""
+
+    def __init__(self, op: str, attempts: int, last: BaseException):
+        super().__init__(f"{op}: gave up after {attempts} attempt(s): {last!r}")
+        self.op = op
+        self.attempts = attempts
+        self.last = last
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: RetryPolicy = DEFAULT_POLICY,
+    op: str = "op",
+    seed: int = 0,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
+
+    Non-retryable exceptions propagate immediately. ``sleep``/``clock``
+    are injectable for tests (virtual time). Outcomes are counted in
+    ``obs``: ``retry.attempts_failed``, ``retry.recovered``,
+    ``retry.gave_up`` — all labeled ``op=...``.
+    """
+    expects(policy.max_attempts >= 1, "max_attempts must be >= 1, got %d",
+            policy.max_attempts)
+    delays = policy.schedule(seed)
+    start = clock()
+    last: Optional[BaseException] = None
+    for attempt in range(policy.max_attempts):
+        try:
+            result = fn(*args, **kwargs)
+            if attempt > 0:
+                obs.inc("retry.recovered", op=op)
+            return result
+        except policy.retryable as e:
+            last = e
+            obs.inc("retry.attempts_failed", op=op, error=type(e).__name__)
+            if attempt == policy.max_attempts - 1:
+                break
+            delay = delays[attempt]
+            if policy.deadline_s is not None and (
+                clock() - start + delay > policy.deadline_s
+            ):
+                obs.inc("retry.deadline_exceeded", op=op)
+                break
+            sleep(delay)
+    obs.inc("retry.gave_up", op=op)
+    raise RetryError(op, policy.max_attempts, last) from last
+
+
+def retrying(policy: RetryPolicy = DEFAULT_POLICY, op: Optional[str] = None, seed: int = 0):
+    """Decorator form of :func:`retry_call`."""
+
+    def deco(fn):
+        import functools
+
+        name = op or getattr(fn, "__qualname__", getattr(fn, "__name__", "op"))
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy, op=name, seed=seed, **kwargs)
+
+        return wrapper
+
+    return deco

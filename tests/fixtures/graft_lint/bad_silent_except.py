@@ -1,0 +1,12 @@
+"""Seeded violation: except handler whose body is only ``pass``.
+
+Expected: exactly one ``silent-except`` on the marked line.
+"""
+
+
+def flush_best_effort(stream):
+    try:
+        stream.flush()
+    except OSError:  # LINT-HERE
+        pass
+    return stream

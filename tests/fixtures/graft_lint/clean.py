@@ -4,8 +4,8 @@ the way graft-lint wants them. Must produce zero violations.
 Covers the negative space of every rule: static-arg branches,
 trace-time shape checks, numpy on static values, explicit dtypes,
 module-scope jit, synced wall-clock timing around jitted calls,
-aligned tiles within budget, and a *derived* (not hard-coded) chunk
-budget.
+aligned tiles within budget, a *derived* (not hard-coded) chunk
+budget, and except handlers that actually handle.
 """
 import functools
 import time
@@ -45,6 +45,18 @@ def timed_relu(x):
     t2 = time.perf_counter()
     overhead = time.perf_counter() - t2
     return y, s, dt + dt2 + overhead
+
+
+def close_quietly(stream, fallback):
+    # silent-except negative space: a handler that *does* something
+    # (returns a fallback / re-raises on the typed path) is fine
+    try:
+        stream.close()
+    except OSError:
+        return fallback
+    except Exception:
+        raise
+    return stream
 
 
 def _copy_kernel(x_ref, o_ref, acc_ref):

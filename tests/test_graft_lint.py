@@ -30,6 +30,7 @@ BAD = {
     "bad_stale_budget.py": "stale-budget",
     "bad_vmem_budget.py": "vmem-budget",
     "bad_vmem_unmodeled.py": "vmem-unmodeled",
+    "bad_silent_except.py": "silent-except",
 }
 
 

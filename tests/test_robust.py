@@ -1,0 +1,627 @@
+"""raft_tpu.robust — chaos suite (ISSUE 4 acceptance tests, CPU).
+
+Fault registry semantics, retry/backoff determinism, degraded-mode
+sharded search on a 4-device virtual mesh, fused→XLA kernel fallback
+parity, transient-bootstrap recovery, checksummed snapshots, and the
+injection-disabled parity guarantee (``RAFT_TPU_FAULTS`` unset → the
+serving stack is bit-identical to a build without the fault points).
+"""
+import io
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu import obs
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.errors import (
+    CorruptIndexError,
+    KernelFailure,
+    RaftError,
+    ShardFailure,
+)
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.parallel import bootstrap, make_mesh
+from raft_tpu.robust import (
+    RetryError,
+    RetryPolicy,
+    faults,
+    probe_shard_health,
+    reset_warned,
+    retry_call,
+    retrying,
+    sharded_search_degraded,
+)
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(autouse=True)
+def _pristine_chaos_state():
+    """Every test starts and ends with injection off, the fault registry
+    empty, and obs off — the production default."""
+    faults.disable()
+    faults.clear()
+    obs.disable()
+    obs.registry().reset()
+    reset_warned()
+    yield
+    faults.disable()
+    faults.clear()
+    obs.disable()
+    obs.registry().reset()
+    reset_warned()
+
+
+@pytest.fixture
+def chaos_obs():
+    reg = obs.registry()
+    reg.reset()
+    obs.enable()
+    yield reg
+    obs.disable()
+    reg.reset()
+
+
+def _data(rng, n, d, nc=32, scale=0.25):
+    c = rng.standard_normal((nc, d)).astype(np.float32)
+    return (c[rng.integers(0, nc, n)] + scale * rng.standard_normal((n, d))).astype(
+        np.float32
+    )
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(11)
+    n, d, nq = 2048, 32, 64
+    return _data(rng, n, d), _data(rng, nq, d)
+
+
+# -- fault registry ---------------------------------------------------------
+
+
+class TestFaultRegistry:
+    def test_disabled_fire_is_noop(self):
+        spec = faults.install("serialize.load", error=CorruptIndexError("chaos"))
+        faults.fire("serialize.load", kind="cagra")  # must not raise
+        assert spec.calls == 0 and spec.fired == 0
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(RaftError):
+            faults.install("no.such.seam", error=RuntimeError("x"))
+
+    def test_unknown_trigger_rejected(self):
+        with pytest.raises(RaftError):
+            faults.install("serialize.load", trigger="whenever")
+
+    def test_always_trigger_and_counts(self):
+        with faults.injected("serialize.load", CorruptIndexError("chaos")) as spec:
+            for _ in range(3):
+                with pytest.raises(CorruptIndexError):
+                    faults.fire("serialize.load", kind="x")
+        assert spec.calls == 3 and spec.fired == 3
+
+    def test_nth_trigger(self):
+        with faults.injected(
+            "bootstrap.init", ConnectionError("chaos"), trigger="nth", nth=2
+        ) as spec:
+            fired = []
+            for _ in range(5):
+                try:
+                    faults.fire("bootstrap.init")
+                    fired.append(False)
+                except ConnectionError:
+                    fired.append(True)
+        assert fired == [False, False, True, False, False]
+        assert spec.fired == 1
+
+    def test_first_n_trigger(self):
+        with faults.injected(
+            "bootstrap.init", ConnectionError("chaos"), trigger="first_n", first_n=2
+        ) as spec:
+            fired = []
+            for _ in range(4):
+                try:
+                    faults.fire("bootstrap.init")
+                    fired.append(False)
+                except ConnectionError:
+                    fired.append(True)
+        assert fired == [True, True, False, False]
+        assert spec.calls == 4 and spec.fired == 2
+
+    def test_probability_trigger_is_seeded(self):
+        def run(seed):
+            out = []
+            with faults.injected(
+                "serialize.load",
+                CorruptIndexError("chaos"),
+                trigger="probability",
+                probability=0.5,
+                seed=seed,
+            ):
+                for _ in range(32):
+                    try:
+                        faults.fire("serialize.load")
+                        out.append(0)
+                    except CorruptIndexError:
+                        out.append(1)
+            return out
+
+        a, b, c = run(7), run(7), run(8)
+        assert a == b  # same seed, same chaos
+        assert a != c  # different seed, different sequence
+        assert 0 < sum(a) < 32  # actually probabilistic
+
+    def test_match_filters_context(self):
+        with faults.injected(
+            "sharded_ann.shard_scan",
+            ShardFailure("chaos", shard=1),
+            match={"shard": 1},
+        ) as spec:
+            faults.fire("sharded_ann.shard_scan", shard=0)  # no match, no raise
+            with pytest.raises(ShardFailure):
+                faults.fire("sharded_ann.shard_scan", shard=1)
+        assert spec.calls == 1  # only the matching call counted
+
+    def test_latency_only_injection(self):
+        import time
+
+        with faults.injected("serialize.load", latency_s=0.02) as spec:
+            t0 = time.perf_counter()
+            faults.fire("serialize.load")  # sleeps, must not raise
+            assert time.perf_counter() - t0 >= 0.015
+        assert spec.fired == 1
+
+    def test_firings_counted_in_obs(self, chaos_obs):
+        with faults.injected("serialize.load", CorruptIndexError("chaos")):
+            with pytest.raises(CorruptIndexError):
+                faults.fire("serialize.load", kind="x")
+        snap = chaos_obs.as_dict()
+        key = 'faults.fired{kind="CorruptIndexError",point="serialize.load"}'
+        assert snap["counters"][key] == 1.0
+
+    def test_injected_restores_prior_state(self):
+        assert not faults.is_enabled()
+        with faults.injected("serialize.load", CorruptIndexError("x")):
+            assert faults.is_enabled()
+            assert len(faults.registry().specs("serialize.load")) == 1
+        assert not faults.is_enabled()
+        assert faults.registry().specs() == []
+
+
+# -- retry / backoff --------------------------------------------------------
+
+
+class TestRetry:
+    def test_schedule_is_deterministic_and_bounded(self):
+        p = RetryPolicy(
+            max_attempts=5, base_delay_s=0.1, multiplier=2.0, max_delay_s=0.5,
+            jitter_frac=0.1,
+        )
+        s = p.schedule(seed=7)
+        assert s == p.schedule(seed=7)
+        assert s != p.schedule(seed=8)
+        assert len(s) == 4
+        bases = [0.1, 0.2, 0.4, 0.5]  # capped at max_delay_s
+        for d, b in zip(s, bases):
+            assert b * 0.9 <= d <= b * 1.1
+
+    def test_recovers_with_virtual_sleep(self, chaos_obs):
+        p = RetryPolicy(max_attempts=4, base_delay_s=0.1, retryable=(ConnectionError,))
+        calls, slept = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("transient")
+            return 42
+
+        assert retry_call(flaky, policy=p, op="t", seed=3, sleep=slept.append) == 42
+        assert len(calls) == 3
+        # the exact deterministic backoff schedule was slept
+        assert tuple(slept) == p.schedule(seed=3)[:2]
+        snap = chaos_obs.as_dict()
+        assert snap["counters"]['retry.recovered{op="t"}'] == 1.0
+        assert (
+            snap["counters"]['retry.attempts_failed{error="ConnectionError",op="t"}']
+            == 2.0
+        )
+
+    def test_gives_up_with_cause(self, chaos_obs):
+        p = RetryPolicy(max_attempts=3, base_delay_s=0.0, retryable=(ValueError,))
+
+        def always():
+            raise ValueError("nope")
+
+        with pytest.raises(RetryError) as exc:
+            retry_call(always, policy=p, op="t", sleep=lambda _: None)
+        assert exc.value.attempts == 3
+        assert isinstance(exc.value.__cause__, ValueError)
+        assert chaos_obs.as_dict()["counters"]['retry.gave_up{op="t"}'] == 1.0
+
+    def test_non_retryable_propagates_immediately(self):
+        p = RetryPolicy(max_attempts=5, retryable=(ConnectionError,))
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise TypeError("logic bug, do not retry")
+
+        with pytest.raises(TypeError):
+            retry_call(bad, policy=p, op="t", sleep=lambda _: None)
+        assert len(calls) == 1
+
+    def test_deadline_stops_early(self, chaos_obs):
+        p = RetryPolicy(
+            max_attempts=10, base_delay_s=1.0, multiplier=1.0, jitter_frac=0.0,
+            deadline_s=2.5, retryable=(ConnectionError,),
+        )
+        now = [0.0]
+
+        def sleep(d):
+            now[0] += d
+
+        def always():
+            raise ConnectionError("x")
+
+        with pytest.raises(RetryError):
+            retry_call(always, policy=p, op="t", sleep=sleep, clock=lambda: now[0])
+        # 2 sleeps fit the 2.5 s budget; the 3rd would exceed it
+        assert now[0] == 2.0
+        snap = chaos_obs.as_dict()
+        assert snap["counters"]['retry.deadline_exceeded{op="t"}'] == 1.0
+
+    def test_retrying_decorator(self):
+        p = RetryPolicy(max_attempts=2, base_delay_s=0.0, retryable=(ConnectionError,))
+        state = {"n": 0}
+
+        @retrying(policy=p, op="deco")
+        def once_flaky():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise ConnectionError("x")
+            return "ok"
+
+        assert once_flaky() == "ok"
+
+
+# -- bootstrap retry --------------------------------------------------------
+
+
+class TestBootstrapRetry:
+    def test_transient_init_faults_are_retried(self):
+        policy = RetryPolicy(
+            max_attempts=4, base_delay_s=0.001, max_delay_s=0.002,
+            retryable=(ConnectionError, TimeoutError, OSError, RuntimeError),
+        )
+        with faults.injected(
+            "bootstrap.init", ConnectionError("coordinator down"),
+            trigger="first_n", first_n=2,
+        ) as spec:
+            # single-host degenerate path: succeeds (False = nothing to do)
+            # once the injected transient window passes
+            assert bootstrap.init_distributed(retry_policy=policy) is False
+        assert spec.fired == 2
+
+    def test_no_policy_fails_fast(self):
+        with faults.injected("bootstrap.init", ConnectionError("coordinator down")):
+            with pytest.raises(ConnectionError):
+                bootstrap.init_distributed(retry_policy=None)
+
+    def test_exhausted_retries_surface_as_retry_error(self):
+        policy = RetryPolicy(
+            max_attempts=2, base_delay_s=0.001, retryable=(ConnectionError,)
+        )
+        with faults.injected("bootstrap.init", ConnectionError("still down")):
+            with pytest.raises(RetryError):
+                bootstrap.init_distributed(retry_policy=policy)
+
+
+# -- degraded-mode sharded search -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def degraded_setup(eight_devices, corpus):
+    X, Q = corpus
+    mesh = make_mesh(eight_devices[:4])
+    flat = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=64, seed=1))
+    pq = ivf_pq.build(X, ivf_pq.IvfPqIndexParams(n_lists=64, pq_dim=8, seed=1))
+    _, exact = brute_force.search(brute_force.build(X), Q, 10)
+    return mesh, flat, pq, Q, np.asarray(exact)
+
+
+class TestDegradedSearch:
+    K = 10
+
+    def test_all_healthy_is_not_degraded(self, degraded_setup):
+        mesh, flat, _pq, Q, _exact = degraded_setup
+        res = sharded_search_degraded(mesh, flat, Q, self.K, n_probes=16)
+        assert res.coverage == 1.0 and not res.degraded and res.failed_shards == ()
+        # unpacks like the plain (distances, indices) result
+        d, i = res
+        assert np.asarray(i).shape == (Q.shape[0], self.K)
+
+    @pytest.mark.parametrize("algo", ["ivf_flat", "ivf_pq_lists"])
+    def test_one_shard_lost_degrades_not_fails(self, degraded_setup, chaos_obs, algo):
+        mesh, flat, pq, Q, exact = degraded_setup
+        index = flat if algo == "ivf_flat" else pq
+        healthy = sharded_search_degraded(
+            mesh, index, Q, self.K, algo=algo, n_probes=16
+        )
+        healthy_recall = float(neighborhood_recall(np.asarray(healthy.indices), exact))
+        with faults.injected(
+            "sharded_ann.shard_scan",
+            ShardFailure("chaos", shard=1),
+            match={"shard": 1},
+        ):
+            res = sharded_search_degraded(mesh, index, Q, self.K, algo=algo, n_probes=16)
+        assert res.degraded and res.coverage == 0.75
+        assert res.failed_shards == (1,)
+        recall = float(neighborhood_recall(np.asarray(res.indices), exact))
+        # losing 1/4 of the lists must not crater quality
+        assert recall >= 0.60 * healthy_recall, (recall, healthy_recall)
+        snap = chaos_obs.as_dict()
+        assert snap["counters"][f'robust.degraded_queries{{algo="{algo}"}}'] == 1.0
+        assert snap["gauges"][f'robust.shards_healthy{{algo="{algo}"}}'] == 3.0
+
+    def test_probe_shard_health_mask(self, degraded_setup):
+        mesh = degraded_setup[0]
+        assert probe_shard_health(mesh) == (True, True, True, True)
+        with faults.injected(
+            "sharded_ann.shard_scan", ShardFailure("chaos", shard=2), match={"shard": 2}
+        ):
+            assert probe_shard_health(mesh) == (True, True, False, True)
+
+    def test_all_shards_down_raises(self, degraded_setup, chaos_obs):
+        mesh, flat, _pq, Q, _exact = degraded_setup
+        with pytest.raises(ShardFailure):
+            sharded_search_degraded(
+                mesh, flat, Q, self.K, health=(False,) * 4, n_probes=16
+            )
+        snap = chaos_obs.as_dict()
+        assert snap["counters"]['robust.queries_failed{algo="ivf_flat"}'] == 1.0
+
+    def test_min_coverage_enforced(self, degraded_setup):
+        mesh, flat, _pq, Q, _exact = degraded_setup
+        with pytest.raises(ShardFailure):
+            sharded_search_degraded(
+                mesh, flat, Q, self.K,
+                health=(True, False, True, True), min_coverage=0.9, n_probes=16,
+            )
+
+    def test_explicit_health_mask_skips_probe(self, degraded_setup):
+        mesh, flat, _pq, Q, _exact = degraded_setup
+        # a spec that would fail shard 0 is ignored when health is given
+        with faults.injected(
+            "sharded_ann.shard_scan", ShardFailure("chaos", shard=0), match={"shard": 0}
+        ) as spec:
+            res = sharded_search_degraded(
+                mesh, flat, Q, self.K, health=(True, True, True, False), n_probes=16
+            )
+        assert spec.calls == 0
+        assert res.failed_shards == (3,) and res.coverage == 0.75
+
+
+# -- fused-kernel -> XLA fallback -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cagra_index(corpus):
+    X, _ = corpus
+    return cagra.build(X, cagra.CagraIndexParams(graph_degree=16, intermediate_graph_degree=24))
+
+
+@pytest.fixture(scope="module")
+def pq_index(corpus):
+    X, _ = corpus
+    return ivf_pq.build(X, ivf_pq.IvfPqIndexParams(n_lists=64, pq_dim=8, seed=1))
+
+
+class TestKernelFallback:
+    K = 10
+
+    def test_cagra_fallback_matches_xla(self, corpus, cagra_index, chaos_obs, monkeypatch):
+        _X, Q = corpus
+        _, base_i = cagra.search(cagra_index, Q, self.K, mode="xla")
+        # on "tpu", auto resolves to the fused Pallas engine; the injected
+        # KernelFailure fires at the host dispatch seam, before any Pallas
+        # compile, and auto must re-route to XLA transparently
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with faults.injected("pallas.cagra_search", KernelFailure("chaos")):
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                _, i = cagra.search(cagra_index, Q, self.K, mode="auto")
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(base_i))
+        msgs = [w for w in caught if issubclass(w.category, RuntimeWarning)]
+        assert len(msgs) == 1 and "falling back" in str(msgs[0].message)
+        snap = chaos_obs.as_dict()
+        assert (
+            snap["counters"]['fallbacks{algo="cagra",reason="KernelFailure"}'] >= 1.0
+        )
+
+    def test_cagra_explicit_fused_does_not_mask(self, corpus, cagra_index, monkeypatch):
+        _X, Q = corpus
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with faults.injected("pallas.cagra_search", KernelFailure("chaos")):
+            with pytest.raises(KernelFailure):
+                cagra.search(cagra_index, Q, self.K, mode="fused")
+
+    def test_ivf_pq_fallback_matches_scan(self, corpus, pq_index, chaos_obs, monkeypatch):
+        X, _ = corpus
+        rng = np.random.default_rng(5)
+        Q128 = _data(rng, 128, X.shape[1])  # auto needs nq >= 128 for fused
+        sp = ivf_pq.IvfPqSearchParams(n_probes=16)
+        _, base_i = ivf_pq.search(pq_index, Q128, self.K, sp, mode="scan")
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with faults.injected("pallas.pq_scan", KernelFailure("chaos")):
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                _, i = ivf_pq.search(pq_index, Q128, self.K, sp, mode="auto")
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(base_i))
+        assert any(issubclass(w.category, RuntimeWarning) for w in caught)
+        snap = chaos_obs.as_dict()
+        assert (
+            snap["counters"]['fallbacks{algo="ivf_pq",reason="KernelFailure"}'] >= 1.0
+        )
+
+    def test_ivf_pq_explicit_fused_does_not_mask(self, corpus, pq_index, monkeypatch):
+        X, _ = corpus
+        rng = np.random.default_rng(5)
+        Q128 = _data(rng, 128, X.shape[1])
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with faults.injected("pallas.pq_scan", KernelFailure("chaos")):
+            with pytest.raises(KernelFailure):
+                ivf_pq.search(
+                    pq_index, Q128, self.K, ivf_pq.IvfPqSearchParams(n_probes=16),
+                    mode="fused",
+                )
+
+
+# -- injection-disabled parity ----------------------------------------------
+
+
+class TestDisabledParity:
+    def test_installed_specs_are_inert_when_disabled(self, corpus, cagra_index):
+        """RAFT_TPU_FAULTS off → the serving stack behaves bit-identically
+        even with armed specs in the registry (the obs-suite parity
+        pattern: the gate, not the registry contents, is the contract)."""
+        _X, Q = corpus
+        _, base_i = cagra.search(cagra_index, Q, 10)
+        faults.install("pallas.cagra_search", KernelFailure("armed but gated"))
+        faults.install("serialize.load", CorruptIndexError("armed but gated"))
+        assert not faults.is_enabled()
+        _, i = cagra.search(cagra_index, Q, 10)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(base_i))
+        buf = io.BytesIO()
+        cagra.save(cagra_index, buf)
+        buf.seek(0)
+        idx2 = cagra.load(buf)  # serialize.load point fires only when enabled
+        _, i2 = cagra.search(idx2, Q, 10)
+        np.testing.assert_array_equal(np.asarray(i2), np.asarray(base_i))
+
+    def test_env_gate_matches_obs_convention(self):
+        for raw, want in (("1", True), ("true", True), ("on", True),
+                          ("yes", True), ("0", False), ("off", False), ("", False)):
+            assert (raw.strip().lower() in ("1", "true", "on", "yes")) is want
+
+
+# -- checksummed snapshots --------------------------------------------------
+
+
+def _snapshot_cases(X, Q):
+    return {
+        "brute_force": (
+            brute_force.build(X),
+            brute_force, lambda m, idx: m.search(idx, Q, 5), {},
+        ),
+        "ivf_flat": (
+            ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=32, seed=1)),
+            ivf_flat, lambda m, idx: m.search(idx, Q, 5, n_probes=8), {},
+        ),
+        "ivf_pq": (
+            ivf_pq.build(X, ivf_pq.IvfPqIndexParams(n_lists=32, pq_dim=8, seed=1)),
+            ivf_pq, lambda m, idx: m.search(idx, Q, 5, n_probes=8), {},
+        ),
+        "cagra": (
+            cagra.build(X, cagra.CagraIndexParams(graph_degree=16)),
+            cagra, lambda m, idx: m.search(idx, Q, 5), {},
+        ),
+    }
+
+
+@pytest.fixture(scope="module")
+def snapshot_cases(corpus):
+    X, Q = corpus
+    return _snapshot_cases(X[:1024], Q[:16])
+
+
+SNAPSHOT_KINDS = ["brute_force", "ivf_flat", "ivf_pq", "cagra"]
+
+
+class TestSnapshots:
+    @pytest.mark.parametrize("kind", SNAPSHOT_KINDS)
+    def test_roundtrip_through_atomic_path(self, snapshot_cases, tmp_path, kind):
+        idx, mod, run, lkw = snapshot_cases[kind]
+        path = os.path.join(tmp_path, f"{kind}.idx")
+        assert mod.save_path(idx, path) == path
+        assert not any(f.name.startswith(f"{kind}.idx.tmp") for f in tmp_path.iterdir())
+        loaded = mod.load_path(path, **lkw)
+        _, i1 = run(mod, idx)
+        _, i2 = run(mod, loaded)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    @pytest.mark.parametrize("kind", SNAPSHOT_KINDS)
+    def test_truncation_detected(self, snapshot_cases, kind):
+        idx, mod, _run, lkw = snapshot_cases[kind]
+        buf = io.BytesIO()
+        mod.save(idx, buf)
+        blob = buf.getvalue()
+        with pytest.raises(CorruptIndexError, match="truncated"):
+            mod.load(io.BytesIO(blob[: len(blob) - 128]), **lkw)
+
+    @pytest.mark.parametrize("kind", SNAPSHOT_KINDS)
+    def test_bit_flip_detected(self, snapshot_cases, kind):
+        idx, mod, _run, lkw = snapshot_cases[kind]
+        buf = io.BytesIO()
+        mod.save(idx, buf)
+        blob = bytearray(buf.getvalue())
+        blob[len(blob) // 2] ^= 0x40  # single flipped bit mid-payload
+        with pytest.raises(CorruptIndexError, match="CRC32"):
+            mod.load(io.BytesIO(bytes(blob)), **lkw)
+
+    def test_legacy_v3_stream_still_loads(self, snapshot_cases, corpus):
+        """Pre-v4 snapshots (bare preamble + body, no checksum) keep
+        loading: the envelope bump must not orphan existing indexes."""
+        _X, Q = corpus
+        idx, mod, run, _lkw = snapshot_cases["ivf_flat"]
+        buf = io.BytesIO()
+        ser.dump_header(buf, "ivf_flat", 3)  # the pre-envelope layout
+        mod._write_body(idx, buf)
+        buf.seek(0)
+        loaded = mod.load(buf)
+        _, i1 = run(mod, idx)
+        _, i2 = run(mod, loaded)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_newer_envelope_rejected(self):
+        buf = io.BytesIO()
+        ser.dump_header(buf, "ivf_flat", ser.SERIALIZATION_VERSION + 1)
+        buf.seek(0)
+        with pytest.raises(ValueError, match="newer"):
+            ser.check_header(buf, "ivf_flat")
+
+    def test_cagra_dataset_less_snapshot(self, snapshot_cases, corpus, tmp_path):
+        X, Q = corpus
+        idx = snapshot_cases["cagra"][0]
+        path = os.path.join(tmp_path, "cg.idx")
+        cagra.save_path(idx, path, include_dataset=False)
+        loaded = cagra.load_path(path, dataset=X[:1024])
+        _, i1 = cagra.search(idx, Q[:16], 5)
+        _, i2 = cagra.search(loaded, Q[:16], 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_storage_fault_point(self, snapshot_cases):
+        """The serialize.load chaos seam: an injected storage fault
+        surfaces as the same typed error a real corruption would."""
+        idx, mod, _run, lkw = snapshot_cases["brute_force"]
+        buf = io.BytesIO()
+        mod.save(idx, buf)
+        with faults.injected(
+            "serialize.load", CorruptIndexError("injected storage rot"),
+            match={"kind": "brute_force"},
+        ):
+            buf.seek(0)
+            with pytest.raises(CorruptIndexError, match="storage rot"):
+                mod.load(buf, **lkw)
+
+    def test_atomic_write_cleans_tmp_on_failure(self, tmp_path):
+        path = os.path.join(tmp_path, "x.idx")
+
+        def boom(_f):
+            raise RuntimeError("writer died")
+
+        with pytest.raises(RuntimeError):
+            ser.atomic_write(path, boom)
+        assert list(tmp_path.iterdir()) == []  # no torn tmp, no dest

@@ -4,14 +4,16 @@ An AST-based static-analysis pass for JAX/Pallas code. The reference
 project pairs its kernels with compile-time correctness tooling
 (template checks, sanitizer CI); graft-lint is the analog for a traced
 Python codebase — it never imports the code under analysis, it parses
-it. Two checker families plug in here:
+it. Three checker families plug in here:
 
 * :mod:`tools.graft_lint.jax_rules` — JAX tracing/correctness lints
   (traced-value branches, numpy calls in jitted paths, static-arg
   declarations, jit-in-loop recompilation hazards, implicit dtypes);
 * :mod:`tools.graft_lint.pallas_rules` — a VMEM resource model for
   Pallas kernels (tile alignment, residency budgets, stale hard-coded
-  byte budgets).
+  byte budgets);
+* :mod:`tools.graft_lint.robust_rules` — fault-visibility lints
+  (silently swallowed exceptions).
 
 Suppression syntax (checked against the violation's reported line)::
 
@@ -105,7 +107,7 @@ class LintModule:
                     else {"*"}
                 )
                 self.suppressions.setdefault(tok.start[0], set()).update(ids)
-        except tokenize.TokenError:
+        except tokenize.TokenError:  # graft-lint: ignore[silent-except]
             pass  # partial comment map beats crashing the lint
 
     def suppressed(self, v: Violation) -> bool:
@@ -115,9 +117,9 @@ class LintModule:
 
 def all_checkers() -> List[Checker]:
     """The default checker set, import-cycle-free registry."""
-    from tools.graft_lint import jax_rules, pallas_rules
+    from tools.graft_lint import jax_rules, pallas_rules, robust_rules
 
-    return [*jax_rules.CHECKERS, *pallas_rules.CHECKERS]
+    return [*jax_rules.CHECKERS, *pallas_rules.CHECKERS, *robust_rules.CHECKERS]
 
 
 def iter_python_files(paths: Sequence[str]) -> Iterator[str]:

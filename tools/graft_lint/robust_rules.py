@@ -1,0 +1,61 @@
+"""Robustness checkers.
+
+The fault-tolerance layer (:mod:`raft_tpu.robust`) only works if
+failures are *visible*: injected faults must surface as typed errors,
+fallbacks must be counted, retries must be logged. The one pattern that
+defeats all of it is the silently swallowed exception:
+
+* ``silent-except`` — an ``except`` handler whose body is only
+  ``pass`` (or ``...``). The failure disappears: no re-raise, no obs
+  counter, no degraded-mode marker. Handle it, count it
+  (``obs.inc(...)``), or at minimum leave a comment and a
+  ``# graft-lint: ignore[silent-except]`` where a human judged the
+  drop safe (e.g. best-effort cache cleanup).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graft_lint.core import Checker, LintModule, Violation
+
+
+def _is_noop(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, ast.Pass):
+        return True
+    # bare `...` as a statement
+    return (
+        isinstance(stmt, ast.Expr)
+        and isinstance(stmt.value, ast.Constant)
+        and stmt.value.value is Ellipsis
+    )
+
+
+class SilentExceptChecker(Checker):
+    rule = "silent-except"
+    doc = (
+        "except handler whose body is only pass/... — the failure is "
+        "swallowed with no re-raise, log, or obs counter"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not all(_is_noop(s) for s in node.body):
+                continue
+            if isinstance(node.type, ast.Name):
+                caught = node.type.id
+            elif node.type is None:
+                caught = "everything"
+            else:
+                caught = ast.unparse(node.type)
+            yield self.violation(
+                module, node,
+                f"except block silently swallows {caught} — re-raise, "
+                "count it via obs.inc(), or suppress with a justifying "
+                "comment",
+            )
+
+
+CHECKERS = [SilentExceptChecker()]
