@@ -76,6 +76,36 @@ METRIC = "ann_best_qps_at_recall95_sift1m_synth_b1024_k10"
 _CHILD_ENV = "_RAFT_TPU_BENCH_CHILD"
 
 
+class _TimedStat(float):
+    """Seconds-per-call (the min over reps — usable anywhere a float
+    was), carrying the rep samples and their p50/p99 so every latency
+    row gets percentile columns comparable run-to-run."""
+
+    __slots__ = ("p50", "p99", "samples")
+
+    def __new__(cls, best, samples):
+        obj = super().__new__(cls, best)
+        obj.samples = tuple(samples)
+        obj.p50 = _percentile(obj.samples, 50)
+        obj.p99 = _percentile(obj.samples, 99)
+        return obj
+
+
+def _percentile(samples, q):
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return float(s[min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))])
+
+
+def _pctl_cols(dt):
+    """p50/p99 millisecond columns for a bench row, when ``dt`` carries
+    samples (every ``_timed`` result does; plain floats add nothing)."""
+    if getattr(dt, "samples", None):
+        return {"p50_ms": round(dt.p50 * 1e3, 3), "p99_ms": round(dt.p99 * 1e3, 3)}
+    return {}
+
+
 def _timed(fn, nrep=2, inner=4, label=None):
     """Min wall-clock per call over ``inner`` pipelined calls per sync.
 
@@ -85,23 +115,35 @@ def _timed(fn, nrep=2, inner=4, label=None):
     most searches). Sync is a scalar fetch because block_until_ready
     no-ops through the tunnel.
 
+    Returns a :class:`_TimedStat`: the min per-call seconds, with the
+    per-rep pipelined means as samples and their p50/p99 attached (the
+    serving rows report true per-request percentiles via the load
+    generator; these columns make the batch rows comparable the same
+    way).
+
     With obs enabled and a ``label``, the measurement region becomes a
-    ``bench.<label>`` span and the per-call result lands in the
-    ``bench.timed_ms`` histogram."""
+    ``bench.<label>`` span, every rep sample lands in the
+    ``bench.timed_ms`` histogram, and the percentiles persist as
+    ``bench.lat_p50_ms``/``bench.lat_p99_ms`` gauges in
+    ``bench_artifacts/metrics.jsonl``."""
     scope = obs.span(f"bench.{label}", nrep=nrep, inner=inner) if label else contextlib.nullcontext()
+    samples = []
     with scope:
         out = fn()
         float(jnp.sum(out[0]))  # warm + sync
-        best = float("inf")
         for _ in range(max(1, nrep)):
             t0 = time.perf_counter()
             for _ in range(inner):
                 out = fn()
             float(jnp.sum(out[0]))
-            best = min(best, (time.perf_counter() - t0) / inner)
+            samples.append((time.perf_counter() - t0) / inner)
+    stat = _TimedStat(min(samples), samples)
     if label and obs.is_enabled():
-        obs.observe("bench.timed_ms", best * 1e3, label=label)
-    return best, out
+        for s in samples:
+            obs.observe("bench.timed_ms", s * 1e3, label=label)
+        obs.set_gauge("bench.lat_p50_ms", stat.p50 * 1e3, label=label)
+        obs.set_gauge("bench.lat_p99_ms", stat.p99 * 1e3, label=label)
+    return stat, out
 
 
 @contextlib.contextmanager
@@ -518,6 +560,7 @@ def _bench_main():
 
     def record(algo, config, dt, idx, **extra_fields):
         row = {"config": config, "qps": round(nq / dt, 1), "recall": round(recall(idx), 4)}
+        row.update(_pctl_cols(dt))
         row.update(extra_fields)
         results.setdefault(algo, []).append(row)
         _rec_add({"algo": algo, **row})
@@ -811,6 +854,7 @@ def _bench_main():
                     "config": f"batch={bq} itopk={sp_lat.itopk_size} w={sp_lat.search_width}",
                     "qps": round(bq / dt, 1),
                     "recall": round(row_rec, 4), "latency_ms": round(dt * 1e3, 2),
+                    **_pctl_cols(dt),
                 }
                 results.setdefault("cagra_latency", []).append(lat_row)
                 _rec_add({"algo": "cagra_latency", **lat_row})
@@ -837,6 +881,7 @@ def _bench_main():
                         ),
                         "qps": round(bq / dt, 1),
                         "recall": round(row_rec, 4), "latency_ms": round(dt * 1e3, 2),
+                        **_pctl_cols(dt),
                     }
                     results.setdefault("cagra_latency", []).append(lat_row)
                     _rec_add({"algo": "cagra_latency", **lat_row})
@@ -849,10 +894,86 @@ def _bench_main():
         cagra_err = cagra_err or f"{type(e).__name__}: {e}"[:200]
         print(f"# cagra skipped: {cagra_err}", flush=True)
 
+    # ---- serving engine: micro-batched online serving (serve_* rows) -----
+    # closed loop finds the throughput-at-concurrency capacity, then an
+    # open loop replays a Poisson stream at ~70% of it — the percentiles
+    # include queueing delay (coordinated-omission safe). Batch-fill and
+    # time-in-queue histograms flow into bench_artifacts/metrics.jsonl
+    # through the engine's obs instrumentation.
+    if over_budget(0.92):
+        print("# serve skipped: time budget", flush=True)
+    else:
+        try:
+            from raft_tpu.bench.loadgen import run_closed_loop, run_open_loop
+            from raft_tpu.serve import ServingEngine
+
+            engine = ServingEngine(max_batch=64, max_wait_ms=2.0,
+                                   queue_capacity=4096)
+            # an index phase that died upstream leaves its variable
+            # unbound — serve whichever indexes actually exist
+            live = locals()
+            serve_targets = []
+            if live.get("fidx") is not None:
+                engine.register(
+                    "flat", "ivf_flat", live["fidx"],
+                    params=ivf_flat.IvfFlatSearchParams(n_probes=30),
+                )
+                serve_targets.append(("flat", "serve_ivf_flat"))
+            if live.get("cidx") is not None:
+                engine.register(
+                    "cagra", "cagra", live["cidx"],
+                    params=cagra.CagraSearchParams(
+                        itopk_size=128, search_width=8, dedup="post"
+                    ),
+                )
+                serve_targets.append(("cagra", "serve_cagra"))
+            qpool = np.asarray(queries)
+            srows = 8
+            n_req = 64 if os.environ.get("RAFT_TPU_BENCH_SMOKE") else 256
+            for index_id, salgo in serve_targets:
+                engine.warmup(index_id, K)
+                rep_c, got_c = run_closed_loop(
+                    engine, index_id, qpool, K,
+                    concurrency=16, n_requests=n_req, request_rows=srows,
+                    collect=True,
+                )
+                rate = max(8.0, 0.7 * rep_c.throughput_qps / srows)
+                rep_o, got_o = run_open_loop(
+                    engine, index_id, qpool, K,
+                    rate_qps=rate, n_requests=n_req, request_rows=srows,
+                    collect=True, seed=0,
+                )
+                for rep, got, cfg in (
+                    (rep_c, got_c, f"closed c=16 rows={srows}"),
+                    (rep_o, got_o, f"open {rate:.0f}req/s rows={srows}"),
+                ):
+                    hits, total = 0.0, 0
+                    for ids, res_idx in got:
+                        hits += float(neighborhood_recall(
+                            np.asarray(res_idx)[:, :K], gt[ids])) * len(ids)
+                        total += len(ids)
+                    rec_val = hits / total if total else 0.0
+                    srow = {"config": cfg, "recall": round(rec_val, 4),
+                            **rep.row()}
+                    results.setdefault(salgo, []).append(srow)
+                    _rec_add({"algo": salgo, **srow})
+                    print(
+                        f"# {salgo:<15s} {cfg:<22s} {srow['qps']:>10} qps"
+                        f"  p50={srow['p50_ms']:.2f} p99={srow['p99_ms']:.2f} ms"
+                        f"  recall={rec_val:.4f} rej={srow['rejected']}",
+                        flush=True,
+                    )
+            cs = engine.cache.stats()
+            print(f"# serve cache: {cs.distinct_programs} compiled programs "
+                  f"({cs.hits} hits / {cs.misses} misses)", flush=True)
+        except Exception as e:  # noqa: BLE001
+            phase_errors["serve"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"# serve failed: {phase_errors['serve']}", flush=True)
+
     # operating points: best QPS at recall >= MIN_RECALL per algorithm
     ops = {}
     for algo, rows in results.items():
-        if algo == "cagra_latency":
+        if algo == "cagra_latency" or algo.startswith("serve_"):
             continue
         ok = [r for r in rows if r["recall"] >= MIN_RECALL]
         ops[algo] = max(ok, key=lambda r: r["qps"]) if ok else None

@@ -5,9 +5,17 @@
 * :mod:`raft_tpu.bench.harness` — build/search timing, in-harness recall,
   gbench-schema results, sweeps, Pareto / operating-point analysis
 * :mod:`raft_tpu.bench.configs` — per-algo parameter grids + constraints
+* :mod:`raft_tpu.bench.loadgen` — open/closed-loop load generation for
+  the :mod:`raft_tpu.serve` engine (the ``serve_*`` bench rows)
 * ``python -m raft_tpu.bench`` — CLI orchestration
 """
 from raft_tpu.bench.datasets import Dataset, get_dataset, make_clustered, make_uniform, read_fbin, write_fbin
+from raft_tpu.bench.loadgen import (
+    LoadReport,
+    poisson_arrivals,
+    run_closed_loop,
+    run_open_loop,
+)
 from raft_tpu.bench.harness import (
     ALGOS,
     BenchResult,
@@ -24,14 +32,18 @@ __all__ = [
     "ALGOS",
     "BenchResult",
     "Dataset",
+    "LoadReport",
     "get_dataset",
     "make_clustered",
     "make_uniform",
     "operating_point",
     "pareto_frontier",
+    "poisson_arrivals",
     "read_fbin",
     "recall_at_k",
     "run_case",
+    "run_closed_loop",
+    "run_open_loop",
     "save_report",
     "sweep",
     "to_report",

@@ -15,6 +15,7 @@ from typing import BinaryIO, Tuple, Union
 
 import numpy as np
 
+from raft_tpu import obs
 from raft_tpu.core.errors import expects
 from raft_tpu.neighbors.cagra import CagraIndex, CagraSearchParams, from_graph, search as cagra_search
 from raft_tpu.ops.distance import DistanceType
@@ -136,8 +137,22 @@ def search(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Base-layer greedy search (the reference delegates to hnswlib's CPU
     searchKnn; here the same graph runs through the batched beam search —
-    ``ef`` maps to ``itopk_size``)."""
-    v, i = cagra_search(
-        index.to_cagra(), queries, k, CagraSearchParams(itopk_size=max(ef, k))
-    )
+    ``ef`` maps to ``itopk_size``).
+
+    With :mod:`raft_tpu.obs` enabled the call is wrapped in an
+    ``hnsw.search`` span (the nested ``cagra.search`` span shows the
+    delegated traversal) with call/query counters."""
+    if not obs.is_enabled():
+        v, i = cagra_search(
+            index.to_cagra(), queries, k, CagraSearchParams(itopk_size=max(ef, k))
+        )
+        return np.asarray(v), np.asarray(i)
+    nq = int(np.shape(queries)[0]) if np.ndim(queries) == 2 else 1
+    obs.inc("hnsw.search.calls", ef=str(ef))
+    obs.inc("hnsw.search.queries", float(nq))
+    with obs.span("hnsw.search", k=k, nq=nq, ef=ef) as sp:
+        v, i = cagra_search(
+            index.to_cagra(), queries, k, CagraSearchParams(itopk_size=max(ef, k))
+        )
+        sp.sync((v, i))
     return np.asarray(v), np.asarray(i)

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core.errors import expects
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import DistanceType, resolve_metric
@@ -148,10 +149,31 @@ def build(
     **kwargs,
 ) -> NNDescentOutput:
     """Build an approximate kNN graph (``nn_descent::build``,
-    ``detail/nn_descent.cuh:1215``)."""
-    res = ensure_resources(res)
+    ``detail/nn_descent.cuh:1215``).
+
+    With :mod:`raft_tpu.obs` enabled the build is wrapped in a
+    device-synced ``nn_descent.build`` span with call/row counters and
+    an iterations-to-convergence histogram."""
     if params is None:
         params = NNDescentParams(**kwargs)
+    if not obs.is_enabled():
+        return _build_impl(dataset, params, res)
+    n = int(np.shape(dataset)[0])
+    obs.inc("nn_descent.build.calls")
+    obs.inc("nn_descent.build.rows", float(n))
+    with obs.span(
+        "nn_descent.build", n=n, graph_degree=params.graph_degree,
+        intermediate=params.intermediate_graph_degree,
+    ) as sp:
+        out = _build_impl(dataset, params, res)
+        sp.sync((out.graph, out.distances))
+        return out
+
+
+def _build_impl(
+    dataset, params: NNDescentParams, res: Optional[Resources]
+) -> NNDescentOutput:
+    res = ensure_resources(res)
     metric = resolve_metric(params.metric)
     expects(metric in _SUPPORTED, "nn_descent does not support metric %s", metric)
     dataset = jnp.asarray(dataset)
@@ -238,6 +260,8 @@ def build(
         if update_rate < params.termination_threshold:
             break
 
+    if obs.is_enabled() and params.max_iterations > 0:
+        obs.observe("nn_descent.build.iterations", float(it + 1))
     graph = acc_i[:, :gd]
     dists = acc_v[:, :gd]
     if metric == DistanceType.L2SqrtExpanded:

@@ -17,7 +17,9 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from raft_tpu import obs
 from raft_tpu.core.errors import expects
 from raft_tpu.neighbors.brute_force import _tile_distances, _NORM_METRICS
 from raft_tpu.ops.distance import DistanceType, is_min_close, resolve_metric, row_norms
@@ -83,8 +85,38 @@ def refine(
     temporary at ~1 GB (CAGRA's graph build refines the WHOLE dataset as
     queries; unbatched that would allocate n * n_cand * dim * 4 bytes).
 
+    With :mod:`raft_tpu.obs` enabled the call is wrapped in a
+    device-synced ``refine.refine`` span with call/query counters and a
+    candidates-per-query histogram.
+
     Returns ``(distances [n_queries, k], indices [n_queries, k])``.
     """
+    if not obs.is_enabled():
+        return _refine_dispatch(
+            dataset, queries, candidates, k, metric, metric_arg, query_batch
+        )
+    nq = int(np.shape(queries)[0])
+    n_cand = int(np.shape(candidates)[1]) if np.ndim(candidates) == 2 else 0
+    obs.inc("refine.refine.calls")
+    obs.inc("refine.refine.queries", float(nq))
+    obs.observe("refine.refine.candidates_per_query", float(n_cand))
+    with obs.span("refine.refine", k=k, nq=nq, candidates=n_cand) as sp:
+        return sp.sync(
+            _refine_dispatch(
+                dataset, queries, candidates, k, metric, metric_arg, query_batch
+            )
+        )
+
+
+def _refine_dispatch(
+    dataset,
+    queries,
+    candidates,
+    k: int,
+    metric,
+    metric_arg: float,
+    query_batch: int,
+) -> Tuple[jax.Array, jax.Array]:
     metric = resolve_metric(metric)
     dataset = jnp.asarray(dataset)
     queries = jnp.asarray(queries)

@@ -74,6 +74,7 @@ FAULT_POINTS = (
     "pallas.pq_scan",         # neighbors/ivf_pq.py fused dispatch branch
     "serialize.load",         # core/serialize.py load_stream
     "bootstrap.init",         # parallel/bootstrap.py init_distributed attempt
+    "serve.dispatch",         # serve/engine.py micro-batch dispatch
 )
 
 

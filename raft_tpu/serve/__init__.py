@@ -1,0 +1,56 @@
+"""raft_tpu.serve — the online query-serving engine.
+
+Sits above every index type (and the sharded paths) and turns a stream
+of small, arrival-timed requests into the large fixed-shape batches the
+fused kernels want, without compiling an unbounded program population:
+
+* :mod:`raft_tpu.serve.bucketing` — power-of-two shape buckets with
+  pad/unpad and an LRU :class:`ProgramCache` of compiled programs
+  (warmup/precompile API included);
+* :mod:`raft_tpu.serve.batcher` — bounded request queue with dynamic
+  micro-batching (flush on ``max_batch`` rows or ``max_wait_ms``),
+  per-request deadlines, and deadline-aware admission control (typed
+  :class:`QueueFull` / :class:`DeadlineExceeded` rejections);
+* :mod:`raft_tpu.serve.engine` — :class:`ServingEngine` futures API
+  plus a synchronous loop driver, routed through the
+  :mod:`raft_tpu.robust` fallback/degrade machinery and instrumented
+  with :mod:`raft_tpu.obs`.
+
+See ``docs/serving.md``.
+"""
+from raft_tpu.serve.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueFull,
+    Request,
+    ServeFuture,
+)
+from raft_tpu.serve.bucketing import (
+    CacheStats,
+    ProgramCache,
+    ProgramKey,
+    bucket_for,
+    bucket_sizes,
+    pad_rows,
+    params_key,
+    unpad_rows,
+)
+from raft_tpu.serve.engine import ServeResult, ServingEngine
+
+__all__ = [
+    "CacheStats",
+    "DeadlineExceeded",
+    "MicroBatcher",
+    "ProgramCache",
+    "ProgramKey",
+    "QueueFull",
+    "Request",
+    "ServeFuture",
+    "ServeResult",
+    "ServingEngine",
+    "bucket_for",
+    "bucket_sizes",
+    "pad_rows",
+    "params_key",
+    "unpad_rows",
+]

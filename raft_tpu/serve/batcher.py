@@ -1,0 +1,297 @@
+"""Bounded request queue with dynamic micro-batching and deadlines.
+
+The batching policy is the classic dynamic-batching tradeoff (Clipper;
+TF-Serving's batching layer; FusionANNS' cooperative batching): hold
+arrivals until either ``max_batch`` query rows are waiting (the fused
+kernels' throughput shape) or the oldest request has waited
+``max_wait_ms`` (the latency bound), then flush one micro-batch.
+
+Overload is handled by *typed rejection*, never unbounded latency:
+
+* the queue is **bounded** (``capacity`` query rows) — a full queue
+  rejects new work with :class:`QueueFull` at submit time
+  (backpressure the caller can act on);
+* every request may carry a **deadline**; a request whose deadline is
+  already unmeetable at submit time (expired, or provably behind the
+  estimated queue drain) is rejected with :class:`DeadlineExceeded`
+  up front (admission control — don't queue work you'll throw away);
+* a request whose deadline expires while queued is *rejected* at
+  batch-formation time — its future completes with
+  :class:`DeadlineExceeded`; nothing is ever silently dropped.
+
+The batcher is synchronous and clock-injectable: tests drive it with a
+virtual clock, the engine drives it with ``time.monotonic``. No
+background thread is required (or started) here.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core.errors import RaftError, expects
+
+
+class QueueFull(RaftError):
+    """The serving queue is at capacity — backpressure: retry later or
+    shed load upstream."""
+
+
+class DeadlineExceeded(RaftError):
+    """The request's deadline cannot be (or was not) met; the request
+    was rejected, not silently dropped."""
+
+
+class ServeFuture:
+    """Minimal thread-safe future for one serving request.
+
+    The engine completes it from its (synchronous or threaded) loop;
+    callers ``result()``/``exception()`` after driving the loop, or
+    block with a timeout when a background driver owns the engine.
+    """
+
+    __slots__ = ("_event", "_result", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def exception(self, timeout: Optional[float] = None) -> Optional[BaseException]:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve future not completed")
+        return self._exc
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve future not completed")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+_req_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One enqueued search request: ``queries`` [m, dim] rows against a
+    registered index, due by ``deadline_s`` (absolute clock time, None =
+    no deadline)."""
+
+    queries: np.ndarray
+    k: int
+    group: Tuple  # requests batch together only within one group key
+    t_arrival: float
+    deadline_s: Optional[float] = None
+    req_id: int = dataclasses.field(default_factory=lambda: next(_req_ids))
+    future: ServeFuture = dataclasses.field(default_factory=ServeFuture)
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.queries.shape[0])
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_s is not None and now > self.deadline_s
+
+
+class MicroBatcher:
+    """Bounded FIFO of :class:`Request` s with flush-on-size /
+    flush-on-age batching and deadline-aware admission.
+
+    ``capacity`` bounds total queued *query rows* (the resource that
+    costs memory and compute), not request count. The service-time
+    EWMA (fed by the engine via :meth:`note_service_time`) powers the
+    admission estimate: a request whose deadline falls before
+    ``now + queued_batches_ahead * ewma_service_s`` is rejected up
+    front rather than queued to die.
+    """
+
+    def __init__(
+        self,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        capacity: int = 1024,
+        clock: Callable[[], float] = None,
+    ):
+        expects(max_batch >= 1, "max_batch must be >= 1")
+        expects(capacity >= max_batch, "capacity %d < max_batch %d", capacity, max_batch)
+        expects(max_wait_ms >= 0.0, "max_wait_ms must be >= 0")
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.capacity = int(capacity)
+        import time as _time
+
+        self._clock = clock if clock is not None else _time.monotonic
+        self._lock = threading.RLock()
+        # bound documents itself; offer() rejects before append so the
+        # maxlen silent-drop semantics can never engage
+        self._queue: "deque[Request]" = deque(maxlen=self.capacity)
+        self._rows = 0
+        self._ewma_service_s = 0.0
+
+    # -- admission ---------------------------------------------------------
+
+    def now(self) -> float:
+        return self._clock()
+
+    def depth_rows(self) -> int:
+        with self._lock:
+            return self._rows
+
+    def depth_requests(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def note_service_time(self, seconds: float, alpha: float = 0.25) -> None:
+        """Feed one observed batch service time into the admission EWMA."""
+        with self._lock:
+            if self._ewma_service_s == 0.0:
+                self._ewma_service_s = float(seconds)
+            else:
+                self._ewma_service_s += alpha * (float(seconds) - self._ewma_service_s)
+
+    def estimated_wait_s(self) -> float:
+        """Pessimistic time for a new arrival to clear the current
+        queue: batches ahead of it times the service-time EWMA. Zero
+        until the engine has reported at least one service time."""
+        with self._lock:
+            if self._ewma_service_s == 0.0:
+                return 0.0
+            batches_ahead = 1 + self._rows // self.max_batch
+            return batches_ahead * self._ewma_service_s
+
+    def offer(self, req: Request) -> None:
+        """Admit ``req`` or raise a typed rejection.
+
+        :class:`QueueFull` when the row bound is hit;
+        :class:`DeadlineExceeded` when the deadline is already past or
+        provably behind the estimated queue drain.
+        """
+        now = self.now()
+        if req.expired(now):
+            raise DeadlineExceeded(
+                f"request {req.req_id} dead on arrival "
+                f"(deadline {req.deadline_s:.4f} < now {now:.4f})"
+            )
+        if req.deadline_s is not None:
+            est = self.estimated_wait_s()
+            if est > 0.0 and now + est > req.deadline_s:
+                raise DeadlineExceeded(
+                    f"request {req.req_id} unmeetable: estimated queue wait "
+                    f"{est * 1e3:.2f} ms overruns the deadline"
+                )
+        with self._lock:
+            if self._rows + req.n_rows > self.capacity:
+                raise QueueFull(
+                    f"serving queue at capacity ({self._rows}/{self.capacity} "
+                    f"query rows); request {req.req_id} rejected"
+                )
+            self._queue.append(req)
+            self._rows += req.n_rows
+
+    # -- batch formation ---------------------------------------------------
+
+    def ready(self, now: Optional[float] = None) -> bool:
+        """True when a micro-batch should flush: a full ``max_batch``
+        rows are queued for some group, or the oldest request has aged
+        past ``max_wait_ms`` (expired requests age instantly)."""
+        if now is None:
+            now = self.now()
+        with self._lock:
+            if not self._queue:
+                return False
+            oldest = self._queue[0]
+            if now - oldest.t_arrival >= self.max_wait_s or oldest.expired(now):
+                return True
+            rows_by_group: Dict[Tuple, int] = {}
+            for r in self._queue:
+                rows_by_group[r.group] = rows_by_group.get(r.group, 0) + r.n_rows
+                if rows_by_group[r.group] >= self.max_batch:
+                    return True
+            return False
+
+    def next_batch(
+        self, now: Optional[float] = None
+    ) -> Tuple[List[Request], List[Request]]:
+        """Form the next micro-batch.
+
+        Returns ``(batch, expired)``: ``batch`` is the oldest-first run
+        of same-group requests totalling at most ``max_batch`` rows;
+        ``expired`` are requests whose deadline passed while queued —
+        already failed with :class:`DeadlineExceeded` on their futures,
+        returned so the caller can count the rejections. Both lists are
+        empty only when the queue is empty.
+        """
+        if now is None:
+            now = self.now()
+        expired: List[Request] = []
+        batch: List[Request] = []
+        with self._lock:
+            # reject the dead first so they can't poison batch formation
+            alive: "deque[Request]" = deque(maxlen=self.capacity)
+            for r in self._queue:
+                if r.expired(now):
+                    expired.append(r)
+                    self._rows -= r.n_rows
+                else:
+                    alive.append(r)
+            self._queue = alive
+            if self._queue:
+                group = self._queue[0].group
+                rows = 0
+                keep: "deque[Request]" = deque(maxlen=self.capacity)
+                for r in self._queue:
+                    if r.group == group and rows + r.n_rows <= self.max_batch:
+                        batch.append(r)
+                        rows += r.n_rows
+                    else:
+                        keep.append(r)
+                self._queue = keep
+                self._rows -= rows
+        for r in expired:
+            r.future.set_exception(
+                DeadlineExceeded(
+                    f"request {r.req_id} expired in queue "
+                    f"(waited {(now - r.t_arrival) * 1e3:.2f} ms)"
+                )
+            )
+        return batch, expired
+
+    def drain_expired(self, now: Optional[float] = None) -> List[Request]:
+        """Reject (only) the expired requests without forming a batch."""
+        if now is None:
+            now = self.now()
+        expired: List[Request] = []
+        with self._lock:
+            alive: "deque[Request]" = deque(maxlen=self.capacity)
+            for r in self._queue:
+                if r.expired(now):
+                    expired.append(r)
+                    self._rows -= r.n_rows
+                else:
+                    alive.append(r)
+            self._queue = alive
+        for r in expired:
+            r.future.set_exception(
+                DeadlineExceeded(
+                    f"request {r.req_id} expired in queue "
+                    f"(waited {(now - r.t_arrival) * 1e3:.2f} ms)"
+                )
+            )
+        return expired

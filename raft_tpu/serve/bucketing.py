@@ -1,0 +1,206 @@
+"""Shape-bucket policy + compiled-program cache for online serving.
+
+Every distinct query-batch shape dispatched to XLA is a distinct
+compiled program; a serving layer that forwards arrival-sized batches
+verbatim compiles an unbounded program population and pays a multi-
+second XLA compile on every new size — the latency cliff FusionANNS
+avoids on GPUs by cooperative batching and that TPUs make strictly
+worse (recompiles are remote and tens of seconds on real pods).
+
+The fix is a *closed* shape vocabulary: query counts are rounded up to
+power-of-two **buckets** (1, 2, 4, ..., ``max_batch``), requests are
+padded to the bucket and un-padded on the way out, so the engine only
+ever dispatches ``log2(max_batch) + 1`` shapes per
+``(index, algo, k, params)`` configuration. :class:`ProgramCache` is
+the LRU cache of those dispatchable programs keyed by
+:class:`ProgramKey`; its stats are the serving layer's compile-storm
+alarm (``tests/test_serve.py`` pins ``misses <= len(bucket_sizes)``
+under a randomized arrival stream) and its :meth:`ProgramCache.warmup`
+hook is how deployments pre-compile the whole vocabulary before taking
+traffic.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.core.errors import expects
+
+
+def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
+    """The closed set of dispatchable query counts: powers of two up to
+    (and including) ``max_batch``, which is rounded up if needed."""
+    expects(max_batch >= 1, "max_batch must be >= 1, got %d", max_batch)
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b <<= 1
+    out.append(b)
+    return tuple(out)
+
+
+def bucket_for(n_queries: int, max_batch: int) -> int:
+    """Smallest bucket holding ``n_queries`` rows (<= ``max_batch``)."""
+    expects(n_queries >= 1, "n_queries must be >= 1, got %d", n_queries)
+    expects(
+        n_queries <= max_batch,
+        "n_queries %d exceeds max_batch %d — split the batch first",
+        n_queries, max_batch,
+    )
+    b = 1
+    while b < n_queries:
+        b <<= 1
+    return b
+
+
+def pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad ``arr`` [n, ...] to ``bucket`` rows (no-op when full)."""
+    n = arr.shape[0]
+    if n == bucket:
+        return arr
+    expects(n < bucket, "rows %d exceed bucket %d", n, bucket)
+    pad = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+def unpad_rows(arr, n: int):
+    """Strip bucket padding back to the ``n`` real rows."""
+    return arr[:n]
+
+
+def params_key(params) -> Tuple:
+    """A hashable identity for a search-params dataclass (or None).
+
+    Field order is the dataclass's own; values that aren't hashable
+    (e.g. dtype objects) are keyed by ``str()``. Two params with equal
+    keys compile to the same program for a given shape.
+    """
+    if params is None:
+        return ()
+    if dataclasses.is_dataclass(params):
+        items = []
+        for f in dataclasses.fields(params):
+            v = getattr(params, f.name)
+            try:
+                hash(v)
+            except TypeError:
+                v = str(v)
+            items.append((f.name, v))
+        return (type(params).__name__,) + tuple(items)
+    return (str(params),)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramKey:
+    """Identity of one compiled serving program: which index, which
+    engine, which padded shape, which k, which knobs."""
+
+    index_id: str
+    algo: str
+    bucket: int
+    k: int
+    params: Tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheStats:
+    """Snapshot of :class:`ProgramCache` counters."""
+
+    hits: int
+    misses: int
+    evictions: int
+    size: int
+
+    @property
+    def distinct_programs(self) -> int:
+        """Programs built over the cache's lifetime (== compile count
+        when every builder compiles exactly one program)."""
+        return self.misses
+
+
+class ProgramCache:
+    """LRU cache of dispatchable search programs keyed by
+    :class:`ProgramKey`.
+
+    A "program" is whatever the builder returns — here, a host callable
+    closed over one ``(index, algo, bucket, k, params)`` configuration
+    whose jitted inner function XLA caches by the bucket's fixed shape.
+    The LRU bound caps host-side closure count; evicting does NOT evict
+    XLA's own compile cache, so a re-miss on an evicted key re-builds
+    the closure cheaply and re-uses the compiled executable.
+    """
+
+    def __init__(self, capacity: int = 64):
+        expects(capacity >= 1, "capacity must be >= 1, got %d", capacity)
+        self.capacity = capacity
+        self._lock = threading.RLock()
+        self._programs: "OrderedDict[ProgramKey, Callable]" = OrderedDict()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    def get(self, key: ProgramKey, builder: Callable[[], Callable]) -> Callable:
+        """Return the cached program for ``key``, building (and counting
+        a miss) on first use; refreshes LRU recency on hits."""
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._hits += 1
+                self._programs.move_to_end(key)
+                return prog
+            self._misses += 1
+        # build outside the lock: builders may trigger long XLA compiles
+        prog = builder()
+        with self._lock:
+            self._programs[key] = prog
+            self._programs.move_to_end(key)
+            while len(self._programs) > self.capacity:
+                self._programs.popitem(last=False)
+                self._evictions += 1
+        return prog
+
+    def warmup(
+        self,
+        keys: Sequence[ProgramKey],
+        builder_for: Callable[[ProgramKey], Callable[[], Callable]],
+    ) -> List[ProgramKey]:
+        """Pre-populate programs for ``keys`` (the precompile API);
+        returns the keys that were actually built (not already cached)."""
+        built = []
+        for key in keys:
+            with self._lock:
+                cached = key in self._programs
+            if not cached:
+                built.append(key)
+            self.get(key, builder_for(key))
+        return built
+
+    def __contains__(self, key: ProgramKey) -> bool:
+        with self._lock:
+            return key in self._programs
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._programs)
+
+    def keys(self) -> List[ProgramKey]:
+        with self._lock:
+            return list(self._programs.keys())
+
+    def stats(self) -> CacheStats:
+        with self._lock:
+            return CacheStats(
+                hits=self._hits,
+                misses=self._misses,
+                evictions=self._evictions,
+                size=len(self._programs),
+            )
+
+    def clear(self) -> None:
+        with self._lock:
+            self._programs.clear()

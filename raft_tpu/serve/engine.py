@@ -1,0 +1,449 @@
+"""Online query-serving engine over the raft_tpu index family.
+
+:class:`ServingEngine` turns a stream of small, arrival-timed search
+requests into the large fixed-shape micro-batches the fused Pallas
+kernels were built for:
+
+* requests enter through a futures API (:meth:`ServingEngine.submit` /
+  :meth:`submit_many`) into a bounded :class:`~raft_tpu.serve.batcher.
+  MicroBatcher` (typed ``QueueFull`` / ``DeadlineExceeded`` rejection,
+  never unbounded latency);
+* micro-batches are padded to the closed power-of-two shape vocabulary
+  of :mod:`raft_tpu.serve.bucketing` and dispatched through an LRU
+  :class:`~raft_tpu.serve.bucketing.ProgramCache`, so the engine only
+  ever compiles ``log2(max_batch)+1`` programs per configuration;
+* dispatch routes through the existing robustness machinery — fused
+  kernels degrade to XLA inside ``mode="auto"`` search (see
+  :mod:`raft_tpu.robust.fallback`), sharded indexes route through
+  :func:`raft_tpu.robust.degrade.sharded_search_degraded` with a timed
+  per-shard health probe, so a failed or *slow* shard yields a
+  degraded response carrying ``coverage < 1.0`` instead of a timeout;
+* the whole path is instrumented with :mod:`raft_tpu.obs`
+  (``serve.queue_depth`` gauge, ``serve.time_in_queue_ms`` /
+  ``serve.batch_fill`` histograms, ``serve.rejections`` counter,
+  ``serve.dispatch`` spans) and chaos-testable at the
+  ``serve.dispatch`` fault seam (:mod:`raft_tpu.robust.faults`).
+
+The engine is **synchronous by design**: :meth:`step` processes at most
+one micro-batch on the caller's thread and :meth:`run_until_idle`
+drains the queue, so tests and single-threaded load generators drive
+it deterministically; a deployment wraps :meth:`step` in its own
+thread/event loop. With obs, faults, and the serve seam all disabled,
+results are bit-identical to calling ``search()`` directly with the
+same parameters (``tests/test_serve.py`` gate-parity).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core.errors import ShardFailure, expects
+from raft_tpu.robust import faults
+from raft_tpu.serve.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueFull,
+    Request,
+    ServeFuture,
+)
+from raft_tpu.serve.bucketing import (
+    ProgramCache,
+    ProgramKey,
+    bucket_for,
+    bucket_sizes,
+    pad_rows,
+    params_key,
+)
+
+#: algo name -> default dispatch mode at registration
+_DEFAULT_MODES = {
+    "brute_force": "exact",
+    "ivf_flat": "auto",
+    "ivf_pq": "auto",
+    "cagra": "auto",
+    "sharded_ivf_flat": "sharded",
+    "sharded_ivf_pq_lists": "sharded",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeResult:
+    """One request's response: results plus the serving telemetry and
+    the health picture they were computed under."""
+
+    distances: np.ndarray  # [m, k]
+    indices: np.ndarray  # [m, k]
+    #: fraction of the index that answered (1.0 on non-sharded paths)
+    coverage: float = 1.0
+    degraded: bool = False
+    failed_shards: Tuple[int, ...] = ()
+    time_in_queue_ms: float = 0.0
+    bucket: int = 0
+    batch_rows: int = 0
+
+    def __iter__(self):  # unpack like a plain (distances, indices)
+        return iter((self.distances, self.indices))
+
+
+@dataclasses.dataclass
+class _Registration:
+    index_id: str
+    algo: str
+    index: object
+    params: object
+    mode: str
+    dataset: object = None
+    mesh: object = None
+    axis: str = "data"
+    min_coverage: float = 0.0
+    search_kwargs: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+class ServingEngine:
+    """Dynamic micro-batching serving engine over registered indexes.
+
+    >>> eng = ServingEngine(max_batch=64, max_wait_ms=2.0)
+    >>> eng.register("wiki", "cagra", index)
+    >>> fut = eng.submit("wiki", query_rows, k=10, deadline_ms=50)
+    >>> eng.run_until_idle()
+    >>> res = fut.result()          # ServeResult
+    """
+
+    def __init__(
+        self,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        queue_capacity: int = 1024,
+        cache_capacity: int = 64,
+        clock: Optional[Callable[[], float]] = None,
+        slow_shard_s: Optional[float] = 0.25,
+    ):
+        self.max_batch = int(max_batch)
+        self.batcher = MicroBatcher(
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            capacity=queue_capacity,
+            clock=clock,
+        )
+        self.cache = ProgramCache(capacity=cache_capacity)
+        #: a health probe slower than this marks the shard unhealthy —
+        #: serve degraded coverage now rather than a timeout later
+        self.slow_shard_s = slow_shard_s
+        self._indexes: Dict[str, _Registration] = {}
+
+    # -- registration ------------------------------------------------------
+
+    def register(
+        self,
+        index_id: str,
+        algo: str,
+        index,
+        *,
+        params=None,
+        mode: Optional[str] = None,
+        dataset=None,
+        mesh=None,
+        axis: str = "data",
+        min_coverage: float = 0.0,
+        **search_kwargs,
+    ) -> None:
+        """Register ``index`` under ``index_id``.
+
+        ``algo`` is one of ``brute_force`` / ``ivf_flat`` / ``ivf_pq`` /
+        ``cagra`` / ``sharded_ivf_flat`` / ``sharded_ivf_pq_lists``.
+        ``params``/``mode``/``search_kwargs`` are pinned at registration
+        and become part of every program key; ``dataset`` enables
+        IVF-PQ exact re-ranking; ``mesh`` is required for the sharded
+        algos and ``min_coverage`` is their floor (below it the request
+        fails with :class:`~raft_tpu.core.errors.ShardFailure` rather
+        than return near-empty results).
+        """
+        expects(algo in _DEFAULT_MODES, "unknown serving algo %r (want one of %s)",
+                algo, ", ".join(sorted(_DEFAULT_MODES)))
+        if algo.startswith("sharded_"):
+            expects(mesh is not None, "sharded algo %r needs mesh=", algo)
+        self._indexes[index_id] = _Registration(
+            index_id=index_id,
+            algo=algo,
+            index=index,
+            params=params,
+            mode=mode if mode is not None else _DEFAULT_MODES[algo],
+            dataset=dataset,
+            mesh=mesh,
+            axis=axis,
+            min_coverage=min_coverage,
+            search_kwargs=dict(search_kwargs),
+        )
+
+    def registered(self) -> List[str]:
+        return list(self._indexes)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(
+        self,
+        index_id: str,
+        queries,
+        k: int,
+        deadline_ms: Optional[float] = None,
+    ) -> ServeFuture:
+        """Enqueue one request (``queries`` [m, dim] or a single [dim]
+        row) and return its future. Raises :class:`QueueFull` /
+        :class:`DeadlineExceeded` at admission — rejected work never
+        occupies the queue."""
+        reg = self._reg(index_id)
+        q = np.asarray(queries)
+        if q.ndim == 1:
+            q = q[None, :]
+        expects(q.ndim == 2, "queries must be [m, dim] (or one [dim] row)")
+        expects(
+            q.shape[0] <= self.max_batch,
+            "request has %d rows > max_batch %d — use submit_many to split",
+            q.shape[0], self.max_batch,
+        )
+        now = self.batcher.now()
+        req = Request(
+            queries=q,
+            k=int(k),
+            group=(index_id, int(k)),
+            t_arrival=now,
+            deadline_s=(now + deadline_ms / 1e3) if deadline_ms is not None else None,
+        )
+        try:
+            self.batcher.offer(req)
+        except QueueFull:
+            obs.inc("serve.rejections", reason="queue_full", index_id=index_id)
+            raise
+        except DeadlineExceeded:
+            obs.inc("serve.rejections", reason="deadline_admission", index_id=index_id)
+            raise
+        if obs.is_enabled():
+            obs.inc("serve.requests", index_id=index_id, algo=reg.algo)
+            obs.set_gauge("serve.queue_depth", self.batcher.depth_rows())
+        return req.future
+
+    def submit_many(
+        self,
+        index_id: str,
+        queries,
+        k: int,
+        deadline_ms: Optional[float] = None,
+        request_rows: int = 1,
+    ) -> List[ServeFuture]:
+        """Split ``queries`` [n, dim] into requests of ``request_rows``
+        rows each and submit them all; returns one future per request."""
+        q = np.asarray(queries)
+        expects(q.ndim == 2, "queries must be [n, dim]")
+        expects(1 <= request_rows <= self.max_batch,
+                "request_rows must be in [1, max_batch]")
+        return [
+            self.submit(index_id, q[s : s + request_rows], k, deadline_ms=deadline_ms)
+            for s in range(0, q.shape[0], request_rows)
+        ]
+
+    # -- the synchronous loop driver ---------------------------------------
+
+    def step(self, force: bool = False) -> int:
+        """Process at most one micro-batch on the calling thread.
+
+        Flushes when the batcher says so (full bucket or aged past
+        ``max_wait_ms``) or unconditionally with ``force=True``.
+        Returns the number of requests completed (including deadline
+        rejections)."""
+        now = self.batcher.now()
+        if not self.batcher.ready(now) and not (force and self.batcher.depth_requests()):
+            return 0
+        batch, expired = self.batcher.next_batch(now)
+        for r in expired:
+            obs.inc("serve.rejections", reason="deadline_expired",
+                    index_id=r.group[0])
+        done = len(expired)
+        if batch:
+            self._dispatch(batch, now)
+            done += len(batch)
+        if obs.is_enabled():
+            obs.set_gauge("serve.queue_depth", self.batcher.depth_rows())
+        return done
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> int:
+        """Drive :meth:`step` until the queue is empty; returns requests
+        completed. The safety valve ``max_steps`` bounds pathological
+        loops (it is not a rate limit)."""
+        total = 0
+        for _ in range(max_steps):
+            if not self.batcher.depth_requests():
+                break
+            total += self.step(force=True)
+        return total
+
+    def queue_depth(self) -> int:
+        return self.batcher.depth_rows()
+
+    # -- precompile --------------------------------------------------------
+
+    def warmup(self, index_id: str, k: int, run: bool = True) -> List[ProgramKey]:
+        """Build (and with ``run=True`` execute on zero queries, forcing
+        the XLA compile) every bucket's program for ``(index_id, k)`` —
+        the deploy-time precompile API. Returns the keys warmed."""
+        reg = self._reg(index_id)
+        pk = params_key(reg.params)
+        keys = [
+            ProgramKey(index_id, reg.algo, b, int(k), pk)
+            for b in bucket_sizes(self.max_batch)
+        ]
+        built = self.cache.warmup(
+            keys, lambda key: (lambda: self._build_program(reg, key.bucket, key.k))
+        )
+        if run:
+            dim = self._index_dim(reg)
+            for key in keys:
+                prog = self.cache.get(
+                    key, lambda: self._build_program(reg, key.bucket, key.k)
+                )
+                zeros = np.zeros((key.bucket, dim), np.float32)
+                out = tuple(prog(zeros))
+                np.asarray(out[0])  # block until the compile+run completes
+        return built
+
+    # -- internals ---------------------------------------------------------
+
+    def _reg(self, index_id: str) -> _Registration:
+        expects(index_id in self._indexes, "no index registered as %r", index_id)
+        return self._indexes[index_id]
+
+    @staticmethod
+    def _index_dim(reg: _Registration) -> int:
+        idx = reg.index
+        if hasattr(idx, "dim"):
+            return int(idx.dim)
+        return int(np.asarray(idx.dataset).shape[1])
+
+    def _probe_health_timed(self, reg: _Registration) -> Tuple[bool, ...]:
+        """Per-shard health through the ``sharded_ann.shard_scan`` fault
+        point, with a latency budget: a probe slower than
+        ``slow_shard_s`` marks the shard unhealthy so the query degrades
+        coverage instead of waiting out a slow shard (the FusionANNS
+        tail-tolerance policy)."""
+        mesh, axis, algo = reg.mesh, reg.axis, reg.algo.replace("sharded_", "")
+        n_shards = mesh.shape[axis]
+        health = []
+        for s in range(n_shards):
+            t0 = time.perf_counter()
+            try:
+                faults.fire("sharded_ann.shard_scan", shard=s, algo=algo, axis=axis)
+                ok = True
+            except ShardFailure:
+                obs.inc("robust.shard_failures", algo=algo, shard=str(s))
+                ok = False
+            if ok and self.slow_shard_s is not None:
+                if time.perf_counter() - t0 > self.slow_shard_s:
+                    obs.inc("serve.slow_shards", index_id=reg.index_id, shard=str(s))
+                    ok = False
+            health.append(ok)
+        return tuple(health)
+
+    def _build_program(self, reg: _Registration, bucket: int, k: int) -> Callable:
+        """One dispatchable closure for ``(reg, bucket, k)``; its jitted
+        inner search is XLA-cached by the bucket's fixed shape."""
+        from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+        kw = reg.search_kwargs
+        if reg.algo == "brute_force":
+            return lambda q: brute_force.search(
+                reg.index, q, k, query_batch=bucket, mode=reg.mode, **kw
+            )
+        if reg.algo == "ivf_flat":
+            return lambda q: ivf_flat.search(
+                reg.index, q, k, reg.params, query_batch=bucket, mode=reg.mode, **kw
+            )
+        if reg.algo == "ivf_pq":
+            return lambda q: ivf_pq.search(
+                reg.index, q, k, reg.params, query_batch=bucket, mode=reg.mode,
+                dataset=reg.dataset, **kw
+            )
+        if reg.algo == "cagra":
+            return lambda q: cagra.search(
+                reg.index, q, k, reg.params, query_batch=bucket, mode=reg.mode, **kw
+            )
+        # sharded paths ride the degraded-search machinery: per-dispatch
+        # timed health probe, failed/slow shards excluded, coverage out
+        from raft_tpu.robust.degrade import sharded_search_degraded
+
+        algo = reg.algo.replace("sharded_", "")
+
+        def sharded_prog(q):
+            health = self._probe_health_timed(reg)
+            return sharded_search_degraded(
+                reg.mesh, reg.index, q, k,
+                algo=algo, params=reg.params, axis=reg.axis,
+                health=health, min_coverage=reg.min_coverage, **kw,
+            )
+
+        return sharded_prog
+
+    def _dispatch(self, batch: Sequence[Request], now: float) -> None:
+        """Pad the batch to its bucket, fetch the compiled program, run
+        it, and complete every request's future. A dispatch failure
+        fails this batch's futures — typed and visible — and the engine
+        keeps serving."""
+        reg = self._reg(batch[0].group[0])
+        k = batch[0].group[1]
+        rows = np.concatenate([r.queries for r in batch], axis=0)
+        n = rows.shape[0]
+        bucket = bucket_for(n, self.max_batch)
+        padded = pad_rows(rows, bucket)
+        key = ProgramKey(reg.index_id, reg.algo, bucket, k, params_key(reg.params))
+        try:
+            program = self.cache.get(
+                key, lambda: self._build_program(reg, bucket, k)
+            )
+            # the chaos seam: one host-level hook before the device work
+            faults.fire(
+                "serve.dispatch",
+                index_id=reg.index_id, algo=reg.algo, bucket=bucket, rows=n,
+            )
+            t0 = time.perf_counter()
+            with obs.span(
+                "serve.dispatch", algo=reg.algo, bucket=bucket, rows=n, k=k
+            ) as sp:
+                out = program(padded)
+                sp.sync(tuple(out))
+            coverage, degraded, failed = 1.0, False, ()
+            if hasattr(out, "coverage"):  # DegradedResult from sharded paths
+                coverage, degraded, failed = out.coverage, out.degraded, out.failed_shards
+            d_np = np.asarray(out.distances if hasattr(out, "distances") else out[0])
+            i_np = np.asarray(out.indices if hasattr(out, "indices") else out[1])
+            self.batcher.note_service_time(time.perf_counter() - t0)
+        except Exception as e:
+            obs.inc("serve.dispatch_errors", index_id=reg.index_id,
+                    kind=type(e).__name__)
+            for r in batch:
+                r.future.set_exception(e)
+            return
+        if obs.is_enabled():
+            obs.inc("serve.batches", index_id=reg.index_id, algo=reg.algo)
+            obs.observe("serve.batch_fill", n / bucket)
+            obs.observe("serve.batch_rows", float(n))
+        off = 0
+        for r in batch:
+            m = r.n_rows
+            tiq_ms = (now - r.t_arrival) * 1e3
+            if obs.is_enabled():
+                obs.observe("serve.time_in_queue_ms", tiq_ms)
+            r.future.set_result(
+                ServeResult(
+                    distances=d_np[off : off + m],
+                    indices=i_np[off : off + m],
+                    coverage=float(coverage),
+                    degraded=bool(degraded),
+                    failed_shards=tuple(failed),
+                    time_in_queue_ms=tiq_ms,
+                    bucket=bucket,
+                    batch_rows=n,
+                )
+            )
+            off += m

@@ -1,0 +1,10 @@
+"""Seeded violation: a work queue constructed without a bound.
+
+Expected: exactly one ``unbounded-queue`` on the marked line.
+"""
+import queue
+
+
+def make_work_queue():
+    pending = queue.Queue()  # LINT-HERE
+    return pending

@@ -5,9 +5,11 @@ Covers the negative space of every rule: static-arg branches,
 trace-time shape checks, numpy on static values, explicit dtypes,
 module-scope jit, synced wall-clock timing around jitted calls,
 aligned tiles within budget, a *derived* (not hard-coded) chunk
-budget, and except handlers that actually handle.
+budget, except handlers that actually handle, and bounded work queues.
 """
+import collections
 import functools
+import queue
 import time
 
 import jax
@@ -45,6 +47,18 @@ def timed_relu(x):
     t2 = time.perf_counter()
     overhead = time.perf_counter() - t2
     return y, s, dt + dt2 + overhead
+
+
+def make_bounded_queues(capacity):
+    # unbounded-queue negative space: every construction carries a bound
+    # (a literal, a positional maxsize, or a runtime expression the
+    # checker trusts)
+    pending = queue.Queue(maxsize=1024)
+    lifo = queue.LifoQueue(64)
+    prio = queue.PriorityQueue(maxsize=capacity)
+    window = collections.deque(maxlen=capacity)
+    tail = collections.deque([], 16)
+    return pending, lifo, prio, window, tail
 
 
 def close_quietly(stream, fallback):

@@ -1,0 +1,631 @@
+"""raft_tpu.serve — online serving engine (ISSUE 5 acceptance, CPU).
+
+Shape-bucketing + ProgramCache (the compile-population bound), the
+bounded micro-batcher (typed QueueFull / DeadlineExceeded, nothing
+silently dropped), gate-parity (engine results bit-identical to direct
+``search()`` with obs/faults/seams all off), degraded sharded serving
+(a latency-injected slow shard yields ``coverage < 1.0``, not a
+timeout), chaos at the ``serve.dispatch`` seam, and the load-generator
+drivers.
+"""
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.bench.loadgen import (
+    percentile,
+    poisson_arrivals,
+    run_closed_loop,
+    run_open_loop,
+)
+from raft_tpu.core.errors import RaftError, ShardFailure
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.parallel import make_mesh
+from raft_tpu.robust import faults
+from raft_tpu.serve import (
+    DeadlineExceeded,
+    MicroBatcher,
+    ProgramCache,
+    ProgramKey,
+    QueueFull,
+    Request,
+    ServingEngine,
+    bucket_for,
+    bucket_sizes,
+    pad_rows,
+    params_key,
+    unpad_rows,
+)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_gates():
+    """Every test starts and ends with injection off, the fault registry
+    empty, and obs off — the production default (and the gate-parity
+    precondition)."""
+    faults.disable()
+    faults.clear()
+    obs.disable()
+    obs.registry().reset()
+    yield
+    faults.disable()
+    faults.clear()
+    obs.disable()
+    obs.registry().reset()
+
+
+@pytest.fixture
+def serve_obs():
+    reg = obs.registry()
+    reg.reset()
+    obs.enable()
+    yield reg
+    obs.disable()
+    reg.reset()
+
+
+def _data(rng, n, d, nc=16, scale=0.25):
+    c = rng.standard_normal((nc, d)).astype(np.float32)
+    return (c[rng.integers(0, nc, n)] + scale * rng.standard_normal((n, d))).astype(
+        np.float32
+    )
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    return _data(rng, 512, 16), _data(rng, 96, 16)
+
+
+@pytest.fixture(scope="module")
+def indexes(corpus):
+    """One small index per algo, params pinned so mode resolution can
+    never differ between the engine and a direct call."""
+    X, _Q = corpus
+    return {
+        "brute_force": (brute_force.build(X), None, "exact", {}),
+        "ivf_flat": (
+            ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=16, seed=3)),
+            ivf_flat.IvfFlatSearchParams(n_probes=8),
+            "probe",
+            {},
+        ),
+        "ivf_pq": (
+            ivf_pq.build(
+                X, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=8, seed=3)
+            ),
+            ivf_pq.IvfPqSearchParams(n_probes=8, refine_ratio=1),
+            "probe",
+            {},
+        ),
+        "cagra": (
+            cagra.build(
+                X,
+                cagra.CagraIndexParams(
+                    intermediate_graph_degree=16, graph_degree=8,
+                    build_algo=cagra.NN_DESCENT,
+                ),
+            ),
+            cagra.CagraSearchParams(itopk_size=32, search_width=2),
+            "xla",
+            {},
+        ),
+    }
+
+
+class VClock:
+    """Deterministic injectable clock."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- bucketing + program cache ----------------------------------------------
+
+
+class TestBucketing:
+    def test_bucket_sizes_are_powers_of_two(self):
+        assert bucket_sizes(64) == (1, 2, 4, 8, 16, 32, 64)
+        assert bucket_sizes(1) == (1,)
+        # non-power-of-two max rounds the top bucket up
+        assert bucket_sizes(48) == (1, 2, 4, 8, 16, 32, 64)
+
+    def test_bucket_for(self):
+        assert [bucket_for(n, 64) for n in (1, 2, 3, 5, 17, 64)] == [
+            1, 2, 4, 8, 32, 64,
+        ]
+        with pytest.raises(RaftError):
+            bucket_for(65, 64)
+        with pytest.raises(RaftError):
+            bucket_for(0, 64)
+
+    def test_pad_unpad_roundtrip(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        p = pad_rows(x, 8)
+        assert p.shape == (8, 4)
+        assert np.array_equal(p[3:], np.zeros((5, 4), np.float32))
+        assert np.array_equal(unpad_rows(p, 3), x)
+        assert pad_rows(x, 3) is x  # full bucket: no copy
+
+    def test_params_key_distinguishes_configs(self):
+        a = params_key(ivf_flat.IvfFlatSearchParams(n_probes=8))
+        b = params_key(ivf_flat.IvfFlatSearchParams(n_probes=16))
+        assert a != b and hash(a) != hash(b)
+        assert params_key(None) == ()
+        # equal params -> equal keys (cache sharing)
+        assert a == params_key(ivf_flat.IvfFlatSearchParams(n_probes=8))
+
+    def test_cache_lru_and_stats(self):
+        cache = ProgramCache(capacity=2)
+        keys = [ProgramKey("i", "a", b, 10) for b in (1, 2, 4)]
+        built = []
+
+        def builder(key):
+            return lambda: built.append(key) or (lambda q: q)
+
+        for k in keys:
+            cache.get(k, builder(k))
+        st = cache.stats()
+        assert st.misses == 3 and st.evictions == 1 and st.size == 2
+        assert keys[0] not in cache and keys[2] in cache
+        cache.get(keys[2], builder(keys[2]))
+        assert cache.stats().hits == 1
+        # re-miss on the evicted key rebuilds (XLA still holds the
+        # executable; only the closure is rebuilt)
+        cache.get(keys[0], builder(keys[0]))
+        assert cache.stats().misses == 4
+
+    def test_cache_warmup_reports_only_new(self):
+        cache = ProgramCache(capacity=8)
+        keys = [ProgramKey("i", "a", b, 10) for b in bucket_sizes(8)]
+        built = cache.warmup(keys, lambda key: (lambda: (lambda q: q)))
+        assert built == keys
+        assert cache.warmup(keys, lambda key: (lambda: (lambda q: q))) == []
+        assert cache.stats().misses == len(keys)
+
+
+# -- micro-batcher -----------------------------------------------------------
+
+
+def _req(rng, rows, clock, k=10, group=("idx", 10), deadline_s=None):
+    return Request(
+        queries=rng.standard_normal((rows, 4)).astype(np.float32),
+        k=k, group=group, t_arrival=clock(), deadline_s=deadline_s,
+    )
+
+
+class TestMicroBatcher:
+    def test_flush_on_size(self):
+        clk, rng = VClock(), np.random.default_rng(0)
+        b = MicroBatcher(max_batch=8, max_wait_ms=1e6, capacity=64, clock=clk)
+        for _ in range(3):
+            b.offer(_req(rng, 3, clk))
+            # 3, then 6 rows: under max_batch and under max_wait
+            if b.depth_rows() < 8:
+                assert not b.ready()
+        assert b.ready()  # 9 rows >= max_batch for the group
+        batch, expired = b.next_batch()
+        assert expired == []
+        assert sum(r.n_rows for r in batch) == 6  # 3+3 fits, 3rd would spill
+        assert b.depth_rows() == 3
+
+    def test_flush_on_age(self):
+        clk, rng = VClock(), np.random.default_rng(0)
+        b = MicroBatcher(max_batch=64, max_wait_ms=5.0, capacity=64, clock=clk)
+        b.offer(_req(rng, 2, clk))
+        assert not b.ready()
+        clk.advance(0.0049)
+        assert not b.ready()
+        clk.advance(0.0002)
+        assert b.ready()
+        batch, _ = b.next_batch()
+        assert len(batch) == 1 and b.depth_rows() == 0
+
+    def test_queue_full_is_typed_backpressure(self):
+        clk, rng = VClock(), np.random.default_rng(0)
+        b = MicroBatcher(max_batch=4, max_wait_ms=1.0, capacity=8, clock=clk)
+        b.offer(_req(rng, 5, clk))
+        b.offer(_req(rng, 3, clk))
+        with pytest.raises(QueueFull):
+            b.offer(_req(rng, 1, clk))
+        assert b.depth_rows() == 8  # the rejected request never entered
+
+    def test_dead_on_arrival_rejected(self):
+        clk, rng = VClock(10.0), np.random.default_rng(0)
+        b = MicroBatcher(max_batch=8, max_wait_ms=1.0, capacity=64, clock=clk)
+        with pytest.raises(DeadlineExceeded):
+            b.offer(_req(rng, 1, clk, deadline_s=9.5))
+
+    def test_admission_uses_service_ewma(self):
+        clk, rng = VClock(), np.random.default_rng(0)
+        b = MicroBatcher(max_batch=4, max_wait_ms=1e6, capacity=64, clock=clk)
+        b.note_service_time(0.050)
+        b.offer(_req(rng, 4, clk))  # one full batch already ahead
+        assert b.estimated_wait_s() >= 0.050
+        # deadline inside the estimated drain -> rejected up front
+        with pytest.raises(DeadlineExceeded):
+            b.offer(_req(rng, 1, clk, deadline_s=clk() + 0.010))
+        # a meetable deadline is admitted
+        b.offer(_req(rng, 1, clk, deadline_s=clk() + 10.0))
+        assert b.depth_rows() == 5
+
+    def test_expiry_in_queue_fails_future_never_drops(self):
+        clk, rng = VClock(), np.random.default_rng(0)
+        b = MicroBatcher(max_batch=8, max_wait_ms=1.0, capacity=64, clock=clk)
+        doomed = _req(rng, 2, clk, deadline_s=clk() + 0.5)
+        alive = _req(rng, 2, clk, deadline_s=clk() + 5.0)
+        b.offer(doomed)
+        b.offer(alive)
+        clk.advance(1.0)  # past doomed's deadline, before alive's
+        batch, expired = b.next_batch()
+        assert [r.req_id for r in expired] == [doomed.req_id]
+        assert [r.req_id for r in batch] == [alive.req_id]
+        assert doomed.future.done()
+        assert isinstance(doomed.future.exception(), DeadlineExceeded)
+        assert not alive.future.done()
+        assert b.depth_rows() == 0  # accounted, not leaked
+
+    def test_groups_do_not_mix(self):
+        clk, rng = VClock(), np.random.default_rng(0)
+        b = MicroBatcher(max_batch=8, max_wait_ms=0.0, capacity=64, clock=clk)
+        a1 = _req(rng, 2, clk, group=("a", 10))
+        b1 = _req(rng, 2, clk, group=("b", 10))
+        a2 = _req(rng, 2, clk, group=("a", 10))
+        for r in (a1, b1, a2):
+            b.offer(r)
+        batch, _ = b.next_batch()
+        assert [r.req_id for r in batch] == [a1.req_id, a2.req_id]
+        batch, _ = b.next_batch()
+        assert [r.req_id for r in batch] == [b1.req_id]
+
+
+# -- engine: program population (acceptance a) -------------------------------
+
+
+class TestProgramPopulation:
+    def test_randomized_arrivals_bounded_compiles(self, corpus, indexes):
+        """Regardless of arrival sizes, the engine compiles at most one
+        program per bucket: misses <= len(bucket_sizes(max_batch))."""
+        _X, Q = corpus
+        rng = np.random.default_rng(42)
+        max_batch = 16
+        eng = ServingEngine(max_batch=max_batch, max_wait_ms=0.0,
+                            queue_capacity=256)
+        idx, params, mode, kw = indexes["brute_force"]
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        sizes = rng.integers(1, max_batch + 1, size=40)
+        futs = []
+        for m in sizes:
+            start = int(rng.integers(0, Q.shape[0] - max_batch))
+            futs.append(eng.submit("bf", Q[start : start + m], k=10))
+            if rng.random() < 0.5:
+                eng.step(force=True)
+        eng.run_until_idle()
+        assert all(f.done() for f in futs)
+        st = eng.cache.stats()
+        assert st.distinct_programs <= len(bucket_sizes(max_batch))
+        assert st.misses + st.hits > 0
+        # every served bucket is a power of two from the closed set
+        buckets = {f.result().bucket for f in futs}
+        assert buckets <= set(bucket_sizes(max_batch))
+        assert len(buckets) >= 2  # the stream actually mixed shapes
+
+    def test_warmup_precompiles_all_buckets(self, indexes):
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        idx, params, mode, kw = indexes["brute_force"]
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        built = eng.warmup("bf", k=10)
+        assert [key.bucket for key in built] == list(bucket_sizes(8))
+        # traffic after warmup never misses
+        misses0 = eng.cache.stats().misses
+        fut = eng.submit("bf", np.zeros((3, idx.dim), np.float32), k=10)
+        eng.run_until_idle()
+        assert fut.done() and eng.cache.stats().misses == misses0
+
+
+# -- engine: gate-parity (acceptance c) --------------------------------------
+
+
+def _direct(algo, idx, params, mode, Q, k, query_batch):
+    if algo == "brute_force":
+        return brute_force.search(idx, Q, k, mode=mode, query_batch=query_batch)
+    if algo == "ivf_flat":
+        return ivf_flat.search(idx, Q, k, params, mode=mode, query_batch=query_batch)
+    if algo == "ivf_pq":
+        return ivf_pq.search(idx, Q, k, params, mode=mode, query_batch=query_batch)
+    return cagra.search(idx, Q, k, params, mode=mode, query_batch=query_batch)
+
+
+class TestGateParity:
+    @pytest.mark.parametrize("algo", ["brute_force", "ivf_flat", "ivf_pq", "cagra"])
+    def test_bit_identical_to_direct_search(self, corpus, indexes, algo):
+        """With obs, faults, and the serve seam all disabled (the autouse
+        fixture's default), ServingEngine results are bit-identical —
+        indices AND distances — to calling search() directly with the
+        same pinned parameters (params, mode, query_batch=bucket)."""
+        assert not obs.is_enabled() and not faults.is_enabled()
+        _X, Q = corpus
+        idx, params, mode, kw = indexes[algo]
+        k = 10
+        eng = ServingEngine(max_batch=16, max_wait_ms=0.0, queue_capacity=256)
+        eng.register(algo, algo, idx, params=params, mode=mode, **kw)
+        # bucket-aligned requests, dispatched one per step: the engine's
+        # program runs the identical shape the direct call compiles
+        off = 0
+        for rows in (1, 2, 4, 8, 16):
+            fut = eng.submit(algo, Q[off : off + rows], k)
+            eng.step(force=True)
+            res = fut.result()
+            dv, di = _direct(algo, idx, params, mode,
+                             Q[off : off + rows], k, query_batch=rows)
+            assert np.array_equal(np.asarray(res.indices), np.asarray(di)), algo
+            assert np.array_equal(np.asarray(res.distances), np.asarray(dv)), algo
+            assert res.coverage == 1.0 and not res.degraded
+            off += rows
+
+    @pytest.mark.parametrize("algo", ["brute_force", "ivf_flat", "ivf_pq", "cagra"])
+    def test_padded_batches_preserve_results(self, corpus, indexes, algo):
+        """Partial buckets (zero-padded, and micro-batched with other
+        requests) return the same neighbors for every row: indices are
+        exact; distances may differ in the last ULP because a different
+        batch shape tiles the distance matmul differently."""
+        _X, Q = corpus
+        idx, params, mode, kw = indexes[algo]
+        k = 10
+        eng = ServingEngine(max_batch=16, max_wait_ms=0.0, queue_capacity=256)
+        eng.register(algo, algo, idx, params=params, mode=mode, **kw)
+        cuts = [(0, 1), (1, 6), (6, 22), (22, 35)]
+        futs = [eng.submit(algo, Q[a:b], k) for a, b in cuts]
+        eng.run_until_idle()
+        for (a, b), fut in zip(cuts, futs):
+            res = fut.result()
+            dv, di = _direct(algo, idx, params, mode, Q[a:b], k,
+                             query_batch=bucket_for(b - a, 16))
+            assert np.array_equal(np.asarray(res.indices), np.asarray(di)), algo
+            np.testing.assert_allclose(
+                np.asarray(res.distances), np.asarray(dv), rtol=1e-5, atol=1e-5
+            )
+
+
+# -- engine: degraded sharded serving (acceptance d) -------------------------
+
+
+@pytest.fixture
+def sharded_engine(eight_devices, corpus):
+    X, Q = corpus
+    mesh = make_mesh(eight_devices[:4])
+    flat = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=64, seed=1))
+    eng = ServingEngine(max_batch=16, max_wait_ms=0.0, queue_capacity=256,
+                        slow_shard_s=0.05)
+    eng.register("shards", "sharded_ivf_flat", flat, mesh=mesh, n_probes=16)
+    return eng, Q
+
+
+class TestDegradedServing:
+    def test_healthy_full_coverage(self, sharded_engine):
+        eng, Q = sharded_engine
+        fut = eng.submit("shards", Q[:4], k=10)
+        eng.run_until_idle()
+        res = fut.result()
+        assert res.coverage == 1.0 and not res.degraded
+        assert np.asarray(res.indices).shape == (4, 10)
+
+    def test_slow_shard_degrades_instead_of_timeout(self, sharded_engine):
+        """A latency-injected shard (slower than slow_shard_s) is marked
+        unhealthy by the timed probe: the request completes promptly with
+        coverage < 1.0 rather than waiting out the slow shard."""
+        eng, Q = sharded_engine
+        with faults.injected(
+            "sharded_ann.shard_scan", latency_s=0.2, match={"shard": 2}
+        ):
+            fut = eng.submit("shards", Q[:4], k=10)
+            eng.run_until_idle()
+        res = fut.result()  # completed, not an exception / timeout
+        assert res.degraded and res.coverage == pytest.approx(0.75)
+        assert res.failed_shards == (2,)
+        assert np.asarray(res.indices).shape == (4, 10)
+
+    def test_failed_shard_degrades(self, sharded_engine, serve_obs):
+        eng, Q = sharded_engine
+        with faults.injected(
+            "sharded_ann.shard_scan",
+            ShardFailure("chaos", shard=1),
+            match={"shard": 1},
+        ):
+            fut = eng.submit("shards", Q[:4], k=10)
+            eng.run_until_idle()
+        res = fut.result()
+        assert res.degraded and res.coverage == pytest.approx(0.75)
+        assert res.failed_shards == (1,)
+
+    def test_min_coverage_floor_fails_typed(self, eight_devices, corpus):
+        X, Q = corpus
+        mesh = make_mesh(eight_devices[:4])
+        flat = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=64, seed=1))
+        eng = ServingEngine(max_batch=16, max_wait_ms=0.0)
+        eng.register("shards", "sharded_ivf_flat", flat, mesh=mesh,
+                     min_coverage=0.9, n_probes=16)
+        with faults.injected(
+            "sharded_ann.shard_scan",
+            ShardFailure("chaos", shard=0),
+            match={"shard": 0},
+        ):
+            fut = eng.submit("shards", Q[:4], k=10)
+            eng.run_until_idle()
+        assert isinstance(fut.exception(), ShardFailure)
+
+
+# -- chaos at the serve.dispatch seam ----------------------------------------
+
+
+class TestServeChaos:
+    def test_dispatch_fault_fails_batch_not_engine(self, corpus, indexes):
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        with faults.injected(
+            "serve.dispatch", RuntimeError("chaos dispatch"), first_n=1,
+            trigger="first_n",
+        ):
+            doomed = eng.submit("bf", Q[:2], k=10)
+            eng.run_until_idle()
+            assert isinstance(doomed.exception(), RuntimeError)
+            # the engine keeps serving after the failed batch
+            ok = eng.submit("bf", Q[:2], k=10)
+            eng.run_until_idle()
+        assert ok.result().indices.shape == (2, 10)
+
+    def test_queue_full_storm_nothing_silently_dropped(self, corpus, indexes,
+                                                       serve_obs):
+        """Overload storm: every submit either returns a future that
+        completes, or raises typed QueueFull — accepted + rejected ==
+        offered, and the rejection counter matches."""
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=4, max_wait_ms=1e6, queue_capacity=8)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        accepted, rejected = [], 0
+        for i in range(30):
+            try:
+                accepted.append(eng.submit("bf", Q[i % 64 : i % 64 + 1], k=10))
+            except QueueFull:
+                rejected += 1
+        assert rejected == 30 - len(accepted) and rejected > 0
+        assert len(accepted) == 8  # capacity rows admitted
+        eng.run_until_idle()
+        assert all(f.done() for f in accepted)
+        assert all(f.exception() is None for f in accepted)
+        snap = serve_obs.as_dict()["counters"]
+        full = [v for k2, v in snap.items()
+                if k2.startswith("serve.rejections") and "queue_full" in k2]
+        assert sum(full) == rejected
+
+    def test_deadline_expiry_mid_queue_counted(self, corpus, indexes,
+                                               serve_obs):
+        """A latency-injected dispatch makes queued requests outlive
+        their deadlines; they are rejected typed (never dropped) and
+        counted under serve.rejections{reason=deadline_expired}."""
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        clk = VClock()
+        eng = ServingEngine(max_batch=4, max_wait_ms=1e6, queue_capacity=64,
+                            clock=clk)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        live = eng.submit("bf", Q[:1], k=10, deadline_ms=10_000.0)
+        doomed = eng.submit("bf", Q[1:2], k=10, deadline_ms=50.0)
+        clk.advance(0.1)  # past doomed's deadline while queued
+        eng.run_until_idle()
+        assert live.result().indices.shape == (1, 10)
+        assert isinstance(doomed.exception(), DeadlineExceeded)
+        snap = serve_obs.as_dict()["counters"]
+        expired = [v for k2, v in snap.items()
+                   if "serve.rejections" in k2 and "deadline_expired" in k2]
+        assert sum(expired) == 1
+
+    def test_obs_histograms_populated(self, corpus, indexes, serve_obs):
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        for s in range(0, 12, 3):
+            eng.submit("bf", Q[s : s + 3], k=10)
+            eng.run_until_idle()
+        snap = serve_obs.as_dict()
+        hists = snap["histograms"]
+        assert any(k.startswith("serve.batch_fill") for k in hists)
+        assert any(k.startswith("serve.time_in_queue_ms") for k in hists)
+        assert any(k.startswith("serve.batch_rows") for k in hists)
+        spans = [s2["name"] for s2 in serve_obs.spans()]
+        assert "serve.dispatch" in spans
+
+
+# -- load generation ---------------------------------------------------------
+
+
+class TestLoadgen:
+    def test_percentile_nearest_rank(self):
+        assert percentile([30.0, 10.0, 20.0], 50) == 20.0
+        assert percentile([30.0, 10.0, 20.0], 0) == 10.0
+        assert percentile([30.0, 10.0, 20.0], 100) == 30.0
+        xs = list(range(1, 101))
+        assert percentile(xs, 99) in (98, 99, 100)
+        assert percentile([], 99) == 0.0
+
+    def test_poisson_arrivals_deterministic_and_rate(self):
+        a = poisson_arrivals(100.0, 2000, seed=5)
+        b = poisson_arrivals(100.0, 2000, seed=5)
+        assert np.array_equal(a, b)
+        assert np.all(np.diff(a) > 0) or np.all(np.diff(a) >= 0)
+        # mean inter-arrival ~ 1/rate (10 ms +- 20%)
+        assert 0.008 < float(np.mean(np.diff(a))) < 0.012
+        with pytest.raises(RaftError):
+            poisson_arrivals(0.0, 10)
+
+    def test_open_loop_accounts_every_request(self, corpus, indexes):
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.5, queue_capacity=64)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        rep, got = run_open_loop(
+            eng, "bf", Q, k=10, rate_qps=2000.0, n_requests=24,
+            request_rows=2, collect=True,
+        )
+        assert rep.mode == "open"
+        assert rep.completed + sum(rep.rejected.values()) == rep.n_requests
+        assert rep.completed == len(got) > 0
+        for ids, res_idx in got:
+            assert res_idx.shape == (len(ids), 10)
+        assert rep.latency_ms_p50 <= rep.latency_ms_p95 <= rep.latency_ms_p99
+
+    def test_closed_loop_accounts_every_request(self, corpus, indexes):
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.5, queue_capacity=64)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        rep, got = run_closed_loop(
+            eng, "bf", Q, k=10, concurrency=4, n_requests=16,
+            request_rows=2, collect=True,
+        )
+        assert rep.mode == "closed"
+        assert rep.completed + sum(rep.rejected.values()) == rep.n_requests
+        assert rep.completed == len(got) > 0
+        assert rep.throughput_qps > 0
+        row = rep.row()
+        assert set(row) == {"qps", "completed", "rejected",
+                            "p50_ms", "p95_ms", "p99_ms"}
+
+
+# -- submit validation -------------------------------------------------------
+
+
+class TestSubmitValidation:
+    def test_single_row_and_oversize(self, corpus, indexes):
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=4, max_wait_ms=0.0)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        fut = eng.submit("bf", Q[0], k=10)  # 1-D row auto-promotes
+        eng.run_until_idle()
+        assert fut.result().indices.shape == (1, 10)
+        with pytest.raises(RaftError):
+            eng.submit("bf", Q[:5], k=10)  # > max_batch: split first
+        with pytest.raises(RaftError):
+            eng.submit("nope", Q[:1], k=10)  # unregistered index
+
+    def test_submit_many_splits(self, corpus, indexes):
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        futs = eng.submit_many("bf", Q[:10], k=10, request_rows=4)
+        assert len(futs) == 3  # 4 + 4 + 2
+        eng.run_until_idle()
+        rows = [f.result().indices.shape[0] for f in futs]
+        assert rows == [4, 4, 2]

@@ -11,6 +11,16 @@ defeats all of it is the silently swallowed exception:
   (``obs.inc(...)``), or at minimum leave a comment and a
   ``# graft-lint: ignore[silent-except]`` where a human judged the
   drop safe (e.g. best-effort cache cleanup).
+
+* ``unbounded-queue`` — a work-queue construction with no bound:
+  ``queue.Queue()`` / ``LifoQueue()`` / ``PriorityQueue()`` without a
+  positive ``maxsize``, ``queue.SimpleQueue()`` (unboundable by
+  design), or ``collections.deque()`` without ``maxlen``. An unbounded
+  queue turns overload into unbounded latency and OOM instead of the
+  typed backpressure the serving layer promises
+  (:class:`raft_tpu.serve.QueueFull`); bound it, or suppress with a
+  ``# graft-lint: ignore[unbounded-queue]`` where the producer is
+  provably bounded (e.g. a fixed-size scratch deque).
 """
 from __future__ import annotations
 
@@ -58,4 +68,82 @@ class SilentExceptChecker(Checker):
             )
 
 
-CHECKERS = [SilentExceptChecker()]
+#: constructors from the ``queue`` module that accept a ``maxsize`` bound
+_BOUNDABLE_QUEUES = ("Queue", "LifoQueue", "PriorityQueue")
+
+
+def _call_name(node: ast.Call):
+    """(module_hint, name) for ``Name(...)`` / ``module.Name(...)``
+    calls; (None, None) for anything fancier (method results, lambdas —
+    stay silent on what we can't identify)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return None, fn.id
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return fn.value.id, fn.attr
+    return None, None
+
+
+def _is_nonpositive_literal(node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return True
+        if isinstance(node.value, (int, float)) and not isinstance(node.value, bool):
+            return node.value <= 0
+    return False
+
+
+class UnboundedQueueChecker(Checker):
+    rule = "unbounded-queue"
+    doc = (
+        "queue.Queue()/deque() work queue constructed without a bound "
+        "(maxsize/maxlen) — overload becomes unbounded latency and OOM "
+        "instead of typed backpressure"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            mod, name = _call_name(node)
+            if name is None:
+                continue
+            if name == "SimpleQueue" and mod in (None, "queue"):
+                # no maxsize parameter exists: unboundable by design
+                yield self.violation(
+                    module, node,
+                    "queue.SimpleQueue() cannot be bounded — use "
+                    "queue.Queue(maxsize=...) so overload is rejected, "
+                    "not accumulated",
+                )
+                continue
+            if name in _BOUNDABLE_QUEUES and mod in (None, "queue"):
+                bound = node.args[0] if node.args else None
+                for kw in node.keywords:
+                    if kw.arg == "maxsize":
+                        bound = kw.value
+                if bound is None or _is_nonpositive_literal(bound):
+                    yield self.violation(
+                        module, node,
+                        f"{name}() has no maxsize bound (<=0 means "
+                        "unbounded) — pass a positive maxsize so a full "
+                        "queue rejects instead of growing",
+                    )
+                continue
+            if name == "deque" and mod in (None, "collections"):
+                # deque(iterable, maxlen) — second positional is the bound
+                bound = node.args[1] if len(node.args) >= 2 else None
+                for kw in node.keywords:
+                    if kw.arg == "maxlen":
+                        bound = kw.value
+                if bound is None or _is_nonpositive_literal(bound):
+                    yield self.violation(
+                        module, node,
+                        "deque() has no maxlen bound — a deque used as a "
+                        "work queue must carry maxlen (and the producer "
+                        "must reject before append: maxlen alone drops "
+                        "silently)",
+                    )
+
+
+CHECKERS = [SilentExceptChecker(), UnboundedQueueChecker()]
