@@ -970,10 +970,98 @@ def _bench_main():
             phase_errors["serve"] = f"{type(e).__name__}: {e}"[:200]
             print(f"# serve failed: {phase_errors['serve']}", flush=True)
 
+    # ---- mutable churn: sustained insert/delete while serving ------------
+    # one mutable ivf_flat index under write pressure: every tick inserts
+    # and deletes a fixed batch, then serves a query batch through the
+    # engine. Two ticks trigger synchronous compaction, so the queued
+    # request's latency includes the rebuild — that p99 spike is the
+    # honest cost of the current lock-held compaction (docs/mutability.md).
+    # recall is measured against a from-scratch rebuild over the final
+    # live rows (ground truth for the original corpus is stale by then).
+    if over_budget(0.94):
+        print("# mutable_churn skipped: time budget", flush=True)
+    else:
+        try:
+            from raft_tpu.mutable import MutableIndex
+            from raft_tpu.serve import ServingEngine as _MutEngine
+
+            m_smoke = bool(os.environ.get("RAFT_TPU_BENCH_SMOKE"))
+            mn = min(n_rows, 4096 if m_smoke else 100_000)
+            ticks = 6 if m_smoke else 30
+            wb = 32  # rows inserted AND deleted per tick
+            base = np.asarray(dataset[:mn], np.float32)
+            mparams = ivf_flat.IvfFlatIndexParams(n_lists=16 if m_smoke else 128)
+            msearch = ivf_flat.IvfFlatSearchParams(n_probes=16 if m_smoke else 32)
+            mut = MutableIndex("ivf_flat", dim, index_params=mparams,
+                               search_params=msearch, name="churn")
+            live_pool = [int(x) for x in mut.insert(base)]
+            mut.compact()
+            meng = _MutEngine(max_batch=64, max_wait_ms=0.5)
+            meng.register_mutable("churn", mut)
+            meng.warmup("churn", K)
+            crng = np.random.default_rng(7)
+            qpool_m = np.asarray(queries, np.float32)
+            lat, lat_compact = [], []
+            compact_at = {ticks // 3, (2 * ticks) // 3}
+            rows_served = 0
+            for t in range(ticks):
+                fresh = base[crng.integers(0, mn, wb)] + 0.01 * crng.standard_normal(
+                    (wb, dim)).astype(np.float32)
+                new_ids = mut.insert(fresh)
+                kill = sorted(crng.choice(len(live_pool), wb, replace=False),
+                              reverse=True)
+                mut.delete(np.asarray([live_pool[j] for j in kill], np.int64))
+                for j in kill:
+                    live_pool.pop(j)
+                live_pool.extend(int(x) for x in new_ids)
+                off = (t * 8) % (nq - 8)
+                t0 = time.perf_counter()
+                fut = meng.submit("churn", qpool_m[off : off + 8], K)
+                if t in compact_at:
+                    mut.compact()  # the queued request rides out the rebuild
+                meng.run_until_idle()
+                fut.result()
+                (lat_compact if t in compact_at else lat).append(
+                    time.perf_counter() - t0)
+                rows_served += 8
+            serve_s = sum(lat) + sum(lat_compact)
+            live_ids, live_vecs = mut.live_rows()
+            d_mut, i_mut = mut.search(qpool_m[:128], K)
+            fresh_idx = ivf_flat.build(live_vecs, params=mparams)
+            _, pos = ivf_flat.search(fresh_idx, qpool_m[:128], K, msearch)
+            i_ref = live_ids[np.clip(np.asarray(pos), 0, None)]
+            overlap = float(np.mean([
+                len(set(i_mut[r]) & set(i_ref[r])) / K for r in range(len(i_mut))
+            ]))
+            churn_row = {
+                "config": f"ivf_flat n={mn} ticks={ticks} writes/tick={2*wb}",
+                "qps": round(rows_served / serve_s, 1),
+                "recall": round(overlap, 4),
+                "p50_ms": round(1e3 * float(np.percentile(lat, 50)), 2),
+                "p99_ms": round(1e3 * float(np.percentile(lat, 99)), 2),
+                "p99_compact_ms": round(1e3 * float(np.max(lat_compact)), 2),
+                "generations": int(mut.generation),
+                "tombstone_fraction": round(mut.tombstone_fraction, 4),
+            }
+            results.setdefault("mutable_churn", []).append(churn_row)
+            _rec_add({"algo": "mutable_churn", **churn_row})
+            mcs = meng.cache.stats()
+            print(f"# mutable_churn    {churn_row['config']:<34s}"
+                  f" {churn_row['qps']:>8} qps  recall-vs-rebuild={overlap:.4f}"
+                  f"  p99={churn_row['p99_ms']:.2f}"
+                  f" p99_compact={churn_row['p99_compact_ms']:.2f} ms"
+                  f"  gens={mut.generation} programs={mcs.distinct_programs}",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            phase_errors["mutable_churn"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"# mutable_churn failed: {phase_errors['mutable_churn']}",
+                  flush=True)
+
     # operating points: best QPS at recall >= MIN_RECALL per algorithm
+    # (latency/serving/churn rows carry their own metrics, not Pareto rows)
     ops = {}
     for algo, rows in results.items():
-        if algo == "cagra_latency" or algo.startswith("serve_"):
+        if algo == "cagra_latency" or algo.startswith("serve_") or algo == "mutable_churn":
             continue
         ok = [r for r in rows if r["recall"] >= MIN_RECALL]
         ops[algo] = max(ok, key=lambda r: r["qps"]) if ok else None

@@ -9,9 +9,12 @@ documents (the schemas match on the fields that matter).
 from __future__ import annotations
 
 import csv
+import io
 import json
 import os
 from typing import Dict, Iterable, List, Sequence, Union
+
+from raft_tpu.core import serialize
 
 # the reference's throughput-mode column set (data_export/__main__.py
 # write_frame_* / skip_driver_cols)
@@ -60,13 +63,13 @@ def export_csv(report: Union[Dict, str], out_path: str) -> str:
         with open(report) as f:
             report = json.load(f)
     rows = _rows_of(report)
-    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
-    with open(out_path, "w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=_COLUMNS)
-        w.writeheader()
-        for r in rows:
-            w.writerow(r)
-    return out_path
+    buf = io.StringIO(newline="")
+    w = csv.DictWriter(buf, fieldnames=_COLUMNS)
+    w.writeheader()
+    for r in rows:
+        w.writerow(r)
+    payload = buf.getvalue().encode("utf-8")
+    return serialize.atomic_write(out_path, lambda f: f.write(payload))
 
 
 def export_results_csv(results: Sequence, out_path: str) -> str:

@@ -27,6 +27,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from raft_tpu.core import serialize
+
 DATA_DIR = os.environ.get("RAFT_TPU_BENCH_DATA", os.path.join(os.path.dirname(__file__), "..", "..", ".bench_cache"))
 
 
@@ -195,9 +197,11 @@ def read_fbin(path: str, dtype=np.float32) -> np.ndarray:
 
 
 def write_fbin(path: str, arr: np.ndarray) -> None:
-    with open(path, "wb") as f:
+    def _write(f):
         np.asarray(arr.shape, np.int32).tofile(f)
         np.ascontiguousarray(arr).tofile(f)
+
+    serialize.atomic_write(path, _write)
 
 
 def load_fbin_dataset(name: str, base_path: str, query_path: str, metric: str = "euclidean", dtype=np.float32) -> Dataset:

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 import numpy as np
 
 from raft_tpu.bench.datasets import Dataset
+from raft_tpu.core import serialize
 
 # ---------------------------------------------------------------------------
 # algorithm adapters (ann_types.hpp:74 ANN<T>::build / ::search analog)
@@ -395,5 +396,5 @@ def to_report(results: Sequence[BenchResult], context: Optional[Dict[str, Any]] 
 
 
 def save_report(results: Sequence[BenchResult], path: str, context: Optional[Dict[str, Any]] = None) -> None:
-    with open(path, "w") as f:
-        json.dump(to_report(results, context), f, indent=2)
+    payload = json.dumps(to_report(results, context), indent=2).encode("utf-8")
+    serialize.atomic_write(path, lambda f: f.write(payload))

@@ -32,7 +32,32 @@ class KernelFailure(RaftError):
 
 class CorruptIndexError(RaftError):
     """A serialized index snapshot failed its integrity check (bad CRC,
-    truncated payload). Raised by :func:`raft_tpu.core.serialize.load_stream`."""
+    truncated payload). Raised by :func:`raft_tpu.core.serialize.load_stream`.
+
+    Carries the forensic detail an operator needs to locate the damage:
+    ``offset`` is the stream position of the failing frame's payload,
+    and ``expected_crc`` / ``actual_crc`` are set on checksum mismatch
+    (both None on truncation)."""
+
+    def __init__(
+        self,
+        msg: str,
+        *,
+        offset: int | None = None,
+        expected_crc: int | None = None,
+        actual_crc: int | None = None,
+    ):
+        detail = []
+        if offset is not None:
+            detail.append(f"offset={offset}")
+        if expected_crc is not None:
+            detail.append(f"expected_crc=0x{expected_crc:08x}")
+        if actual_crc is not None:
+            detail.append(f"actual_crc=0x{actual_crc:08x}")
+        super().__init__(f"{msg} [{', '.join(detail)}]" if detail else msg)
+        self.offset = offset
+        self.expected_crc = expected_crc
+        self.actual_crc = actual_crc
 
 
 def expects(cond: bool, msg: str, *args) -> None:

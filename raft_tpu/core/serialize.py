@@ -159,13 +159,19 @@ def load_stream(stream: BinaryIO, kind: str) -> Tuple[int, BinaryIO]:
     index_version = int(deserialize_scalar(stream, "uint32"))
     length = int(deserialize_scalar(stream, "uint64"))
     crc = int(deserialize_scalar(stream, "uint32"))
+    payload_offset = stream.tell() if stream.seekable() else None
     payload = stream.read(length)
     if len(payload) != length:
         raise CorruptIndexError(
-            f"truncated {kind} snapshot: payload is {len(payload)} of {length} bytes"
+            f"truncated {kind} snapshot: payload is {len(payload)} of {length} bytes",
+            offset=payload_offset,
         )
-    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
-        raise CorruptIndexError(f"{kind} snapshot failed its CRC32 check")
+    actual = zlib.crc32(payload) & 0xFFFFFFFF
+    if actual != crc:
+        raise CorruptIndexError(
+            f"{kind} snapshot failed its CRC32 check",
+            offset=payload_offset, expected_crc=crc, actual_crc=actual,
+        )
     return index_version, io.BytesIO(payload)
 
 

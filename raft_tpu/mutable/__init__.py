@@ -1,0 +1,24 @@
+"""raft_tpu.mutable — crash-consistent mutability over immutable indexes.
+
+Segmented architecture (:mod:`~raft_tpu.mutable.segments`): a
+generation-numbered main segment (any index type, tombstones masked
+in-scan) plus a small brute-force delta segment for fresh rows; every
+mutation is WAL-durable before it is visible
+(:mod:`~raft_tpu.mutable.wal`); compaction rebuilds and atomically
+publishes the next generation (:mod:`~raft_tpu.mutable.compact`,
+:mod:`~raft_tpu.mutable.manifest`). See ``docs/mutability.md``.
+"""
+from raft_tpu.mutable.compact import compact
+from raft_tpu.mutable.manifest import Manifest
+from raft_tpu.mutable.segments import MutableIndex, Snapshot
+from raft_tpu.mutable.wal import WalRecord, WriteAheadLog, replay
+
+__all__ = [
+    "Manifest",
+    "MutableIndex",
+    "Snapshot",
+    "WalRecord",
+    "WriteAheadLog",
+    "compact",
+    "replay",
+]

@@ -1,0 +1,143 @@
+"""Compaction: fold delta rows + tombstones into a rebuilt main segment.
+
+Compaction takes every *live* row (main rows not tombstoned, plus delta
+rows not tombstoned, in stable segment order), rebuilds the main-segment
+index from scratch, and publishes the result as generation ``g+1``:
+
+1. write ``gen-NNNNNNNN/rows.bin`` (raw rows + global ids, checksummed
+   v4 envelope) and ``gen-NNNNNNNN/main.idx`` (the per-algo snapshot),
+   both via the atomic temp-fsync-rename writer;
+2. flip ``MANIFEST.json`` to the new generation with
+   :func:`raft_tpu.mutable.manifest.swap` — the only mutable file;
+3. switch the in-memory index over (empty delta, empty tombstones, a
+   fresh per-generation WAL) and best-effort delete the old
+   generation's artifacts.
+
+Crash matrix: a kill at the ``compact.merge`` seam (before any byte is
+written) or anywhere during step 1 leaves the old manifest pointing at
+the old, untouched generation — recovery sees the pre-compaction state
+with its WAL intact. A kill at the ``manifest.swap`` seam leaves the new
+generation's files on disk as orphans but the old manifest live — still
+pre-state. Only once the rename lands is the new generation visible,
+and then it is complete by construction. There is no crash point that
+yields a hybrid.
+
+The rebuild is deterministic (same rows in the same order through the
+same seeded builder), so post-compaction search is bit-for-bit equal to
+a from-scratch build over the live rows — the freshness acceptance
+gate in ``tests/test_mutable.py``.
+
+Compaction currently runs synchronously under the index lock (writers
+and snapshot() block; already-taken snapshots keep serving). The p99
+spike this causes under churn is measured by the ``mutable_churn``
+bench row; moving the rebuild off-lock is future work.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+
+from raft_tpu import obs
+from raft_tpu.mutable import manifest as man
+from raft_tpu.mutable import segments as seg
+from raft_tpu.robust import faults
+
+
+def _save_main(algo: str, index, path: str) -> str:
+    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+    if algo == "brute_force":
+        return brute_force.save_path(index, path)
+    if algo == "ivf_flat":
+        return ivf_flat.save_path(index, path)
+    if algo == "ivf_pq":
+        return ivf_pq.save_path(index, path)
+    if algo == "cagra":
+        # rows live in the sidecar; don't store the dataset twice
+        return cagra.save_path(index, path, include_dataset=False)
+    raise ValueError(f"unknown mutable algo {algo!r}")
+
+
+def compact(mut: "seg.MutableIndex", res=None) -> int:
+    """Merge ``mut``'s delta + tombstones into a new main segment and
+    publish it atomically. Returns the new generation number."""
+    t0 = time.perf_counter()
+    with mut._lock:
+        old_gen = mut.generation
+        new_gen = old_gen + 1
+        ids, vecs = mut.live_rows()
+        # chaos seam: a kill here (or anywhere before the manifest flip)
+        # has written nothing the old manifest references — pre-state
+        faults.fire("compact.merge", generation=new_gen, rows=len(ids))
+        index = seg._build_main(mut.algo, vecs, mut.index_params, mut.metric) if len(ids) else None
+
+        old_wal_path = mut.wal.path if mut.wal is not None else None
+        if mut.directory is not None:
+            gen_name = seg._gen_dirname(new_gen)
+            gen_dir = os.path.join(mut.directory, gen_name)
+            os.makedirs(gen_dir, exist_ok=True)
+            rows_rel = os.path.join(gen_name, "rows.bin")
+            seg._save_rows(os.path.join(mut.directory, rows_rel), ids, vecs)
+            main_rel = None
+            if index is not None:
+                main_rel = os.path.join(gen_name, "main.idx")
+                _save_main(mut.algo, index, os.path.join(mut.directory, main_rel))
+            man.swap(
+                mut.directory,
+                man.Manifest(
+                    generation=new_gen,
+                    algo=mut.algo,
+                    dim=mut.dim,
+                    main=main_rel,
+                    rows=rows_rel,
+                    wal=seg._wal_name(new_gen),
+                    next_id=mut.next_id,
+                ),
+            )
+
+        # the new generation is durable and live on disk — switch memory
+        mut._id_loc.clear()
+        dim = mut.dim
+        import numpy as np
+
+        mut._delta_data = np.zeros((seg._DELTA_MIN_CAP, dim), np.float32)
+        mut._delta_ids = np.full((seg._DELTA_MIN_CAP,), -1, np.int64)
+        mut._delta_live = np.zeros((seg._DELTA_MIN_CAP,), bool)
+        mut._n_delta = 0
+        mut._n_delta_dead = 0
+        mut._delta_bf_cache = (-1, None)
+        mut._install_main(ids, vecs, index, res=res)
+        mut.generation = new_gen
+        mut.version += 1
+        mut._snap = None
+
+        if mut.directory is not None:
+            if mut.wal is not None:
+                mut.wal.close()
+            mut.wal, _ = seg.WriteAheadLog.open(
+                os.path.join(mut.directory, seg._wal_name(new_gen))
+            )
+            _cleanup_old_generation(mut.directory, old_gen, old_wal_path)
+
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        if obs.is_enabled():
+            obs.inc("mutable.compactions", index=mut.name)
+            obs.observe("mutable.compact.duration_ms", dur_ms, index=mut.name)
+            obs.observe("mutable.compact.rows", float(len(ids)), index=mut.name)
+        mut._note_obs()
+        return new_gen
+
+
+def _cleanup_old_generation(directory: str, old_gen: int, old_wal_path) -> None:
+    """Best-effort removal of the superseded generation's artifacts —
+    they are unreferenced once the manifest flip landed, so a failure
+    here only leaks disk (recovery ignores orphans)."""
+    try:
+        old_dir = os.path.join(directory, seg._gen_dirname(old_gen))
+        if os.path.isdir(old_dir):
+            shutil.rmtree(old_dir)
+        if old_wal_path and os.path.exists(old_wal_path):
+            os.unlink(old_wal_path)
+    except OSError:  # graft-lint: ignore[silent-except] — orphan cleanup is advisory
+        pass

@@ -1,0 +1,187 @@
+"""Crash-consistent write-ahead log for mutable indexes.
+
+Every mutation (insert/delete/upsert) is **durable before it is
+visible**: the op is framed, CRC32-checksummed, appended, flushed, and
+fsync'd to the generation's WAL *before* the in-memory delta segment or
+tombstone bitset changes (the Faiss add-with-ids/remove story recast
+for a process that can die at any instruction). A reader recovering
+after a crash replays whatever prefix of the log survived: the frame
+discipline is the same envelope idea as serialization v4
+(:func:`raft_tpu.core.serialize.save_stream`) — length + CRC ahead of
+the payload — applied per record, so a torn tail (partial header,
+partial payload, or bit rot) truncates cleanly to the last whole
+record instead of poisoning the whole log.
+
+Frame layout (little-endian)::
+
+    b"WALR" | u32 payload_len | u32 crc32(payload) | payload
+
+Payload: ``op`` string, ``ids`` int64 array, has-vectors flag, and the
+``vectors`` float array when the op carries rows, all via the
+:mod:`raft_tpu.core.serialize` primitives.
+
+The chaos seam ``wal.append`` (:mod:`raft_tpu.robust.faults`) fires
+twice per append — ``stage="pre"`` before any byte is written (a crash
+here loses the mutation entirely: pre-state on recovery) and
+``stage="post"`` after the fsync (the mutation is durable but the
+caller never saw it applied: post-state on recovery). Both outcomes
+are legal; a *mixed* state is not, and ``tests/test_mutable.py`` kills
+at both stages for every mutation kind to prove it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import os
+import struct
+import zlib
+from typing import BinaryIO, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.errors import expects
+
+_REC_MAGIC = b"WALR"
+_HEADER = struct.Struct("<4sII")  # magic, payload_len, crc32
+
+#: mutation kinds a WAL record may carry
+OPS = ("insert", "delete", "upsert")
+
+
+@dataclasses.dataclass(frozen=True)
+class WalRecord:
+    """One durable mutation: the op kind, the global ids it touches,
+    and (for insert/upsert) the rows themselves."""
+
+    op: str
+    ids: np.ndarray  # int64[n]
+    vectors: Optional[np.ndarray] = None  # float32[n, dim] for insert/upsert
+
+    def encode(self) -> bytes:
+        buf = io.BytesIO()
+        ser.serialize_string(buf, self.op)
+        ser.serialize_array(buf, np.asarray(self.ids, np.int64))
+        ser.serialize_scalar(buf, int(self.vectors is not None), "uint32")
+        if self.vectors is not None:
+            ser.serialize_array(buf, np.asarray(self.vectors, np.float32))
+        return buf.getvalue()
+
+    @staticmethod
+    def decode(payload: bytes) -> "WalRecord":
+        buf = io.BytesIO(payload)
+        op = ser.deserialize_string(buf)
+        ids = np.asarray(ser.deserialize_array(buf))
+        has_vecs = bool(ser.deserialize_scalar(buf, "uint32"))
+        vectors = np.asarray(ser.deserialize_array(buf)) if has_vecs else None
+        return WalRecord(op=op, ids=ids, vectors=vectors)
+
+
+def replay(path: str) -> Tuple[List[WalRecord], int]:
+    """Read the longest valid prefix of the log at ``path``.
+
+    Returns ``(records, good_offset)`` where ``good_offset`` is the byte
+    offset just past the last whole, CRC-clean frame. Anything beyond it
+    is a torn tail (truncated header, truncated payload, magic or CRC
+    damage) — counted in ``mutable.wal.torn_tail_bytes`` and meant to be
+    truncated away by :meth:`WriteAheadLog.open`. A missing file is an
+    empty log.
+    """
+    records: List[WalRecord] = []
+    good = 0
+    if not os.path.exists(path):
+        return records, good
+    with open(path, "rb") as f:
+        data = f.read()
+    n = len(data)
+    while good < n:
+        head = data[good : good + _HEADER.size]
+        if len(head) < _HEADER.size:
+            break
+        magic, length, crc = _HEADER.unpack(head)
+        if magic != _REC_MAGIC:
+            break
+        payload = data[good + _HEADER.size : good + _HEADER.size + length]
+        if len(payload) < length or zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            break
+        try:
+            records.append(WalRecord.decode(payload))
+        except Exception:
+            # a frame whose CRC passes but whose payload cannot decode is
+            # still a torn/foreign tail — stop at the last good record
+            break
+        good += _HEADER.size + length
+    torn = n - good
+    if torn and obs.is_enabled():
+        obs.inc("mutable.wal.torn_tail_bytes", float(torn))
+    return records, good
+
+
+class WriteAheadLog:
+    """Append-only durable mutation log (one per index generation).
+
+    Use :meth:`open` — it replays the valid prefix, truncates any torn
+    tail, and positions the write cursor for appends.
+    """
+
+    def __init__(self, path: str, fh: BinaryIO, offset: int):
+        self.path = path
+        self._fh = fh
+        self._offset = offset
+
+    @classmethod
+    def open(cls, path: str) -> Tuple["WriteAheadLog", List[WalRecord]]:
+        """Open (creating if missing) the log at ``path``; returns the
+        log plus the records recovered from its valid prefix."""
+        records, good = replay(path)
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        # "a+b" creates when missing; reopen r+b to truncate a torn tail
+        fh = open(path, "a+b")
+        fh.seek(0, os.SEEK_END)
+        if fh.tell() != good:
+            fh.close()
+            fh = open(path, "r+b")
+            fh.truncate(good)
+            fh.seek(good)
+            fh.flush()
+            os.fsync(fh.fileno())
+        if obs.is_enabled() and records:
+            obs.inc("mutable.wal.replayed", float(len(records)))
+        return cls(path, fh, good), records
+
+    @property
+    def offset(self) -> int:
+        return self._offset
+
+    def append(self, record: WalRecord) -> int:
+        """Make ``record`` durable (write + flush + fsync); returns the
+        offset past the appended frame. The caller applies the mutation
+        to the in-memory segments only after this returns."""
+        expects(record.op in OPS, "unknown WAL op %r", record.op)
+        payload = record.encode()
+        frame = _HEADER.pack(_REC_MAGIC, len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        # chaos seam: a crash before any byte lands loses the mutation
+        # (pre-state on recovery) ...
+        from raft_tpu.robust import faults
+
+        faults.fire("wal.append", op=record.op, stage="pre")
+        self._fh.seek(self._offset)
+        self._fh.write(frame)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._offset += len(frame)
+        # ... and a crash after the fsync leaves it durable but
+        # unacknowledged (post-state on recovery)
+        faults.fire("wal.append", op=record.op, stage="post")
+        if obs.is_enabled():
+            obs.inc("mutable.wal.records", op=record.op)
+            obs.inc("mutable.wal.bytes", float(len(frame)))
+        return self._offset
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:  # graft-lint: ignore[silent-except] — double-close on teardown is benign
+            pass

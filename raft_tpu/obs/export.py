@@ -12,10 +12,12 @@ share.
 """
 from __future__ import annotations
 
+import io
 import json
 import os
 from typing import Any, Dict, Optional
 
+from raft_tpu.core import serialize
 from raft_tpu.obs import metrics as _metrics
 
 
@@ -96,9 +98,10 @@ def write_trace(path: str, registry: Optional[_metrics.Registry] = None) -> str:
     """Write (and validate) the Chrome-trace JSON; returns ``path``."""
     doc = chrome_trace(registry)
     validate_trace(doc)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(doc, f)
-    return path
+    payload = json.dumps(doc).encode("utf-8")
+    # temp-fsync-rename: a crash mid-export must not tear a trace a
+    # later tooling pass would choke on
+    return serialize.atomic_write(path, lambda f: f.write(payload))
 
 
 def load_trace(path: str) -> Dict[str, Any]:
@@ -112,6 +115,7 @@ def load_trace(path: str) -> Dict[str, Any]:
 def write_metrics_jsonl(path: str, registry: Optional[_metrics.Registry] = None) -> str:
     """Write the metrics + spans JSONL snapshot; returns ``path``."""
     reg = registry or _metrics.registry()
-    with open(path, "w", encoding="utf-8") as f:
-        reg.dump_jsonl(f)
-    return path
+    buf = io.StringIO()
+    reg.dump_jsonl(buf)
+    payload = buf.getvalue().encode("utf-8")
+    return serialize.atomic_write(path, lambda f: f.write(payload))

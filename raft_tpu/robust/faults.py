@@ -75,6 +75,9 @@ FAULT_POINTS = (
     "serialize.load",         # core/serialize.py load_stream
     "bootstrap.init",         # parallel/bootstrap.py init_distributed attempt
     "serve.dispatch",         # serve/engine.py micro-batch dispatch
+    "wal.append",             # mutable/wal.py durable append (stage pre/post)
+    "compact.merge",          # mutable/compact.py before any artifact write
+    "manifest.swap",          # mutable/manifest.py between durability and rename
 )
 
 

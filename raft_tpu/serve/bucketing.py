@@ -105,6 +105,11 @@ class ProgramKey:
     bucket: int
     k: int
     params: Tuple = ()
+    #: mutable-index generation the program was compiled against; 0 for
+    #: immutable registrations. Bumping it on compaction retires stale
+    #: programs via LRU instead of serving against a dead snapshot, and
+    #: bounds distinct programs to generations × buckets per config.
+    generation: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

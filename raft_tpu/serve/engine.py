@@ -84,6 +84,9 @@ class ServeResult:
     time_in_queue_ms: float = 0.0
     bucket: int = 0
     batch_rows: int = 0
+    #: mutable-index generation the answer was computed against (0 for
+    #: immutable registrations) — lets clients reason about freshness
+    generation: int = 0
 
     def __iter__(self):  # unpack like a plain (distances, indices)
         return iter((self.distances, self.indices))
@@ -176,6 +179,34 @@ class ServingEngine:
             mesh=mesh,
             axis=axis,
             min_coverage=min_coverage,
+            search_kwargs=dict(search_kwargs),
+        )
+
+    def register_mutable(
+        self,
+        index_id: str,
+        mutable,
+        *,
+        params=None,
+        **search_kwargs,
+    ) -> None:
+        """Register a :class:`raft_tpu.mutable.MutableIndex`.
+
+        Each micro-batch is dispatched against one immutable
+        :meth:`~raft_tpu.mutable.segments.MutableIndex.snapshot` taken
+        at dispatch time, so concurrent insert/delete/upsert (and
+        compaction's generation flips) are atomic with respect to
+        serving — a batch sees the whole mutation or none of it. The
+        snapshot's generation joins the :class:`ProgramKey`, retiring
+        stale programs through the LRU and bounding distinct programs
+        to ``generations × (log2(max_batch)+1)`` per configuration.
+        """
+        self._indexes[index_id] = _Registration(
+            index_id=index_id,
+            algo="mutable",
+            index=mutable,
+            params=params,
+            mode="snapshot",
             search_kwargs=dict(search_kwargs),
         )
 
@@ -291,8 +322,10 @@ class ServingEngine:
         the deploy-time precompile API. Returns the keys warmed."""
         reg = self._reg(index_id)
         pk = params_key(reg.params)
+        snap = reg.index.snapshot() if reg.algo == "mutable" else None
+        generation = snap.generation if snap is not None else 0
         keys = [
-            ProgramKey(index_id, reg.algo, b, int(k), pk)
+            ProgramKey(index_id, reg.algo, b, int(k), pk, generation)
             for b in bucket_sizes(self.max_batch)
         ]
         built = self.cache.warmup(
@@ -305,7 +338,7 @@ class ServingEngine:
                     key, lambda: self._build_program(reg, key.bucket, key.k)
                 )
                 zeros = np.zeros((key.bucket, dim), np.float32)
-                out = tuple(prog(zeros))
+                out = tuple(prog(zeros, snap) if snap is not None else prog(zeros))
                 np.asarray(out[0])  # block until the compile+run completes
         return built
 
@@ -352,6 +385,10 @@ class ServingEngine:
         from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
 
         kw = reg.search_kwargs
+        if reg.algo == "mutable":
+            # the snapshot is NOT baked into the closure — it arrives per
+            # dispatch, so a cached program can never serve a stale view
+            return lambda q, snap: snap.search(q, k, params=reg.params, **kw)
         if reg.algo == "brute_force":
             return lambda q: brute_force.search(
                 reg.index, q, k, query_batch=bucket, mode=reg.mode, **kw
@@ -396,7 +433,13 @@ class ServingEngine:
         n = rows.shape[0]
         bucket = bucket_for(n, self.max_batch)
         padded = pad_rows(rows, bucket)
-        key = ProgramKey(reg.index_id, reg.algo, bucket, k, params_key(reg.params))
+        # one snapshot per micro-batch: every request in the batch sees
+        # the same immutable view, and writers never race the dispatch
+        snap = reg.index.snapshot() if reg.algo == "mutable" else None
+        generation = snap.generation if snap is not None else 0
+        key = ProgramKey(
+            reg.index_id, reg.algo, bucket, k, params_key(reg.params), generation
+        )
         try:
             program = self.cache.get(
                 key, lambda: self._build_program(reg, bucket, k)
@@ -410,7 +453,7 @@ class ServingEngine:
             with obs.span(
                 "serve.dispatch", algo=reg.algo, bucket=bucket, rows=n, k=k
             ) as sp:
-                out = program(padded)
+                out = program(padded, snap) if snap is not None else program(padded)
                 sp.sync(tuple(out))
             coverage, degraded, failed = 1.0, False, ()
             if hasattr(out, "coverage"):  # DegradedResult from sharded paths
@@ -428,6 +471,9 @@ class ServingEngine:
             obs.inc("serve.batches", index_id=reg.index_id, algo=reg.algo)
             obs.observe("serve.batch_fill", n / bucket)
             obs.observe("serve.batch_rows", float(n))
+            if snap is not None:
+                obs.set_gauge("serve.generation", float(generation),
+                              index_id=reg.index_id)
         off = 0
         for r in batch:
             m = r.n_rows
@@ -444,6 +490,7 @@ class ServingEngine:
                     time_in_queue_ms=tiq_ms,
                     bucket=bucket,
                     batch_rows=n,
+                    generation=generation,
                 )
             )
             off += m

@@ -1,0 +1,10 @@
+"""Seeded violation: a persisted artifact written in place.
+
+Expected: exactly one ``non-atomic-write`` on the marked line.
+"""
+import json
+
+
+def save_manifest(path, doc):
+    with open(path, "w") as f:  # LINT-HERE
+        json.dump(doc, f)

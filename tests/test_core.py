@@ -125,6 +125,69 @@ class TestBitset:
         assert bool(bm.test(jnp.array(1), jnp.array(2))) == m[1, 2]
 
 
+class TestBitsetUnderJit:
+    """Tombstone-mask semantics under jit — the in-scan delete path of
+    the mutable layer (`raft_tpu/mutable/segments.py`) relies on these
+    holding inside compiled programs, not just eagerly."""
+
+    def test_set_unset_count_jitted(self):
+        @jax.jit
+        def mutate(bs, on, off):
+            return bs.set(on).unset(off)
+
+        bs = Bitset.create(130, default=False)
+        bs = mutate(bs, jnp.array([0, 64, 129]), jnp.array([64]))
+        assert int(jax.jit(lambda b: b.count())(bs)) == 2
+        got = bs.test(jnp.array([0, 64, 129]))
+        np.testing.assert_array_equal(np.asarray(got), [True, False, True])
+
+    def test_count_matches_mask_sum_jitted(self, rng):
+        mask = rng.random(257) < 0.4
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        count = jax.jit(lambda b: b.count())(bs)
+        assert int(count) == int(mask.sum())
+
+    def test_mask_then_topk_equals_filter_then_topk(self, rng):
+        # the delete correctness identity: masking distances to +inf
+        # inside the scan (what prefilter does) must select exactly the
+        # rows a host-side filter-then-top-k selects
+        n, k = 96, 8
+        dist = rng.random(n).astype(np.float32)
+        dist += np.arange(n, dtype=np.float32) * 1e-4  # break ties
+        keep = rng.random(n) < 0.6
+        bs = Bitset.from_mask(jnp.asarray(keep))
+
+        @jax.jit
+        def mask_then_topk(b, d):
+            masked = jnp.where(b.to_mask(), d, jnp.inf)
+            return jax.lax.top_k(-masked, k)[1]
+
+        got = np.sort(np.asarray(mask_then_topk(bs, jnp.asarray(dist))))
+        want = np.sort(np.argsort(np.where(keep, dist, np.inf))[:k])
+        np.testing.assert_array_equal(got, want)
+
+    def test_prefilter_in_scan_matches_host_filter(self, rng):
+        # end-to-end over a real index: brute-force search with a
+        # tombstone prefilter == search over the physically filtered set
+        from raft_tpu.neighbors import brute_force
+
+        data = rng.standard_normal((120, 8)).astype(np.float32)
+        q = rng.standard_normal((3, 8)).astype(np.float32)
+        keep = rng.random(120) < 0.7
+        bs = Bitset.from_mask(jnp.asarray(keep))
+        idx = brute_force.build(data)
+        d_mask, i_mask = brute_force.search(idx, q, 5, prefilter=bs, mode="exact")
+        kept = np.flatnonzero(keep)
+        idx_f = brute_force.build(data[kept])
+        d_filt, i_filt = brute_force.search(idx_f, q, 5, mode="exact")
+        np.testing.assert_array_equal(
+            kept[np.asarray(i_filt)], np.asarray(i_mask)
+        )
+        np.testing.assert_allclose(
+            np.asarray(d_mask), np.asarray(d_filt), rtol=1e-5, atol=1e-5
+        )
+
+
 class TestInterruptible:
     def test_yield_no_throw(self):
         assert not interruptible.yield_no_throw()

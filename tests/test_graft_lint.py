@@ -32,6 +32,7 @@ BAD = {
     "bad_vmem_unmodeled.py": "vmem-unmodeled",
     "bad_silent_except.py": "silent-except",
     "bad_unbounded_queue.py": "unbounded-queue",
+    "bad_non_atomic_write.py": "non-atomic-write",
 }
 
 

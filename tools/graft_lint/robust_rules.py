@@ -12,6 +12,18 @@ defeats all of it is the silently swallowed exception:
   ``# graft-lint: ignore[silent-except]`` where a human judged the
   drop safe (e.g. best-effort cache cleanup).
 
+* ``non-atomic-write`` — ``open(path, "w"/"wb")`` straight onto a
+  persisted artifact path. A crash (or fault injection) mid-write
+  leaves a torn file that a later reader sees as corruption; the
+  serialization layer's contract is temp + fsync + ``os.replace``
+  (:func:`raft_tpu.core.serialize.atomic_write`), under which a
+  half-written file can never be observed at the published path.
+  Writes whose target is visibly a temp name, or that sit in a
+  function that also renames (``os.replace``/``os.rename``) or calls
+  ``atomic_write``, are recognized as the idiom itself and not
+  flagged; transient debug/scratch output gets a rationale'd
+  ``# graft-lint: ignore[non-atomic-write]``.
+
 * ``unbounded-queue`` — a work-queue construction with no bound:
   ``queue.Queue()`` / ``LifoQueue()`` / ``PriorityQueue()`` without a
   positive ``maxsize``, ``queue.SimpleQueue()`` (unboundable by
@@ -146,4 +158,73 @@ class UnboundedQueueChecker(Checker):
                     )
 
 
-CHECKERS = [SilentExceptChecker(), UnboundedQueueChecker()]
+#: names whose presence in the enclosing function marks the atomic
+#: temp-then-rename idiom (the open() is the temp leg, not the publish)
+_ATOMIC_MARKERS = ("replace", "rename", "atomic_write")
+
+
+def _write_mode(node: ast.Call):
+    """The string literal mode of an ``open()`` call when it is a plain
+    write ("w"/"wb", any +/encoding flags), else None."""
+    mode = node.args[1] if len(node.args) >= 2 else None
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return None
+    return mode.value if mode.value.startswith("w") else None
+
+
+def _mentions_temp(expr: ast.expr) -> bool:
+    text = ast.unparse(expr).lower()
+    return "tmp" in text or "temp" in text
+
+
+class NonAtomicWriteChecker(Checker):
+    rule = "non-atomic-write"
+    doc = (
+        'open(path, "w"/"wb") straight onto a persisted path — a crash '
+        "mid-write publishes a torn file; use the temp-fsync-rename "
+        "idiom (core.serialize.atomic_write)"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        # map each node to its nearest enclosing function so the
+        # atomic-idiom scan stays local (a rename elsewhere in the
+        # module must not excuse an unrelated write)
+        scope_of = {}
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for child in ast.walk(fn):
+                    scope_of[child] = fn  # innermost wins: walk order is outer-first,
+                # so later (inner) functions overwrite their children's entries
+        atomic_scopes = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                _, name = _call_name(node)
+                if name in _ATOMIC_MARKERS:
+                    atomic_scopes.add(id(scope_of.get(node)))
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            mod, name = _call_name(node)
+            if name != "open" or mod not in (None, "io"):
+                continue
+            mode = _write_mode(node)
+            if mode is None or not node.args:
+                continue
+            if _mentions_temp(node.args[0]):
+                continue  # the temp leg of the idiom
+            if id(scope_of.get(node)) in atomic_scopes:
+                continue  # enclosing function renames/atomic-writes
+            yield self.violation(
+                module, node,
+                f'open(..., "{mode}") writes the published path directly '
+                "— a crash mid-write leaves a torn artifact; write a temp "
+                "file, fsync, then os.replace (see "
+                "core.serialize.atomic_write), or suppress with a "
+                "rationale for transient output",
+            )
+
+
+CHECKERS = [SilentExceptChecker(), UnboundedQueueChecker(), NonAtomicWriteChecker()]

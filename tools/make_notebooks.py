@@ -254,7 +254,9 @@ def main():
         "tutorial_ivf_pq.ipynb": IVF_PQ,
     }.items():
         path = os.path.join(out, name)
-        with open(path, "w") as f:
+        # generated docs, fully reproducible from this script — a torn
+        # write is fixed by rerunning, not worth the rename dance
+        with open(path, "w") as f:  # graft-lint: ignore[non-atomic-write]
             json.dump(nb, f, indent=1)
             f.write("\n")
         print("wrote", path)

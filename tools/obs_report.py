@@ -159,11 +159,28 @@ def render_report(*paths: str, top: int = 10) -> str:
         ]
         sections.append(f"## top {len(rows)} spans by self-time\n"
                         + _table(rows, ["span", "count", "self_ms", "total_ms", "mean_ms"]))
-    if counters:
-        rows = [[k, f"{v:g}"] for k, v in sorted(counters.items())]
+    # robustness + mutability get their own table: fault fires, retries,
+    # fallbacks, WAL traffic, tombstone fraction, generations — the
+    # health picture an operator scans first, pulled out of the generic
+    # tables so it cannot drown in per-algo serving counters
+    health_rows = [
+        [k, kind, f"{v:g}"]
+        for kind, table in (("counter", counters), ("gauge", gauges))
+        for k, v in sorted(table.items())
+        if k.startswith(("robust.", "mutable.", "faults."))
+    ]
+    if health_rows:
+        sections.append("## robustness & mutability\n"
+                        + _table(health_rows, ["metric", "kind", "value"]))
+    plain = {k: v for k, v in counters.items()
+             if not k.startswith(("robust.", "mutable.", "faults."))}
+    if plain:
+        rows = [[k, f"{v:g}"] for k, v in sorted(plain.items())]
         sections.append("## counters\n" + _table(rows, ["counter", "value"]))
-    if gauges:
-        rows = [[k, f"{v:g}"] for k, v in sorted(gauges.items())]
+    plain_g = {k: v for k, v in gauges.items()
+               if not k.startswith(("robust.", "mutable.", "faults."))}
+    if plain_g:
+        rows = [[k, f"{v:g}"] for k, v in sorted(plain_g.items())]
         sections.append("## gauges\n" + _table(rows, ["gauge", "value"]))
     if histograms:
         rows = [
