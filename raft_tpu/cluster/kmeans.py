@@ -27,14 +27,16 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from raft_tpu import obs
 from raft_tpu.core.errors import expects
 from raft_tpu.core.resources import Resources, ensure_resources
-from raft_tpu.ops.distance import DistanceType, resolve_metric
+from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms
 from raft_tpu.ops.fused_1nn import min_cluster_and_distance
 from raft_tpu.random.rng import as_key
+from raft_tpu.utils.math import cdiv
 
 
 @dataclasses.dataclass
@@ -51,6 +53,7 @@ class KMeansParams:
     oversampling_factor: float = 2.0  # kept for param parity; unused by Lloyd
     batch_samples: int = 1 << 15  # kept for param parity; the E step is
     #   already memory-bounded by the fused argmin scan, so no batching knob
+    algorithm: str = "lloyd"  # "lloyd" | "flash" (Flash-KMeans exact E step)
 
 
 @dataclasses.dataclass
@@ -98,6 +101,249 @@ def _update_centroids(X, labels, k: int, old_centroids, weights):
     return jnp.where(counts[:, None] > 0, means, old_centroids), counts
 
 
+# -- Flash-KMeans exact E step ----------------------------------------------
+# "Flash-KMeans: Fast and Memory-Efficient Exact K-Means" (PAPERS.md): three
+# changes to the assignment step, none of which alter a single bit of the
+# result relative to :func:`min_cluster_and_distance`:
+#
+# 1. **norm caching** — ``||x||^2`` (and for cosine the unit rows) are
+#    computed once per fit and reused every EM iteration; the fused scan
+#    recomputes them inside the ``while_loop`` body each time.
+# 2. **blocked assignment** — rows are processed in MXU-sized blocks against
+#    center tiles, one ``[block, tile]`` matmul per step.
+# 3. **norm-difference bounds** — ``d(x, c) >= | ||x|| - ||c|| |`` lets a
+#    whole center tile be skipped via ``lax.cond`` (the matmul truly does
+#    not run) when no row in the block can improve on its running best.
+#
+# The bound is deflated by a worst-case f32 rounding margin so it only
+# suppresses tiles whose *computed* distances provably cannot win, and
+# replacement stays strict-(</>) with first-seen ties — so labels,
+# distances, and the convergence trajectory are bit-identical to the
+# default path ("bit-compatible convergence").
+
+_F32_EPS = float(np.finfo(np.float32).eps)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "sqrt"))
+def _flash_assign_l2(Xb, xnb, sxb, ct, cnt, sct, *, tile: int, sqrt: bool):
+    """Blocked bound-skipping L2 assignment over pre-tiled inputs.
+
+    ``Xb [nb, block, d]``, ``xnb/sxb [nb, block]`` (squared norms / norms,
+    zero on row padding); ``ct [nt, tile, d]``, ``cnt/sct [nt, tile]`` with
+    ``inf`` norms marking center padding. Returns ``(labels, dists)`` each
+    ``[nb * block]``, matching :func:`fused_l2_nn` bit-for-bit."""
+    n_tiles, _, d = ct.shape
+    # |computed d2 - true d2| <= eps * O(d) * (||x|| + ||c||)^2 covers both
+    # the dot's length-d accumulation and the xn + cn - 2dot cancellation.
+    margin_scale = jnp.float32(_F32_EPS * (d + 8.0))
+
+    def per_block(blk):
+        xb, xn, sx = blk
+
+        def body(carry, inputs):
+            t, yt, ynt, syt = inputs
+            bv0, _ = carry
+            pad = ynt == jnp.inf
+            lb = (sx[:, None] - syt[None, :]) ** 2
+            lb = lb - margin_scale * (sx[:, None] + syt[None, :]) ** 2
+            lb = jnp.where(pad[None, :], jnp.inf, lb)
+            can_skip = jnp.all(jnp.min(lb, axis=1) >= bv0)
+
+            def compute(c):
+                bv, bi = c
+                dot = lax.dot_general(
+                    xb, yt, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                d2 = xn[:, None] + ynt[None, :] - 2.0 * dot
+                d2 = jnp.maximum(d2, 0.0)
+                d2 = jnp.where(pad[None, :], jnp.inf, d2)
+                tile_val = jnp.min(d2, axis=1)
+                tile_arg = jnp.argmin(d2, axis=1).astype(jnp.int32) + t * tile
+                take_new = tile_val < bv
+                return (
+                    jnp.where(take_new, tile_val, bv),
+                    jnp.where(take_new, tile_arg, bi),
+                )
+
+            carry = lax.cond(can_skip, lambda c: c, compute, carry)
+            return carry, None
+
+        init = (
+            jnp.full(xb.shape[:1], jnp.inf, jnp.float32),
+            jnp.zeros(xb.shape[:1], jnp.int32),
+        )
+        (bv, bi), _ = lax.scan(body, init, (jnp.arange(n_tiles), ct, cnt, sct))
+        return bv, bi
+
+    vals, idxs = lax.map(per_block, (Xb, xnb, sxb))
+    vals = vals.reshape(-1)
+    if sqrt:
+        vals = jnp.sqrt(vals)
+    return idxs.reshape(-1), vals
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _flash_assign_ip(Xb, sxb, ct, sct, vt, *, tile: int):
+    """Blocked max-inner-product assignment with a Cauchy-Schwarz skip:
+    ``dot(x, c) <= ||x|| * ||c||`` (inflated by the rounding margin), so a
+    tile whose upper bound cannot beat the running best never runs its
+    matmul. Matches :func:`_fused_ip_nn_impl` bit-for-bit."""
+    n_tiles, _, d = ct.shape
+    margin_scale = jnp.float32(_F32_EPS * (d + 8.0))
+
+    def per_block(blk):
+        xb, sx = blk
+
+        def body(carry, inputs):
+            t, yt, syt, vtt = inputs
+            bv0, _ = carry
+            ub = sx[:, None] * syt[None, :]
+            ub = jnp.where(vtt[None, :], ub + margin_scale * ub, -jnp.inf)
+            can_skip = jnp.all(jnp.max(ub, axis=1) <= bv0)
+
+            def compute(c):
+                bv, bi = c
+                dot = lax.dot_general(
+                    xb, yt, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                dot = jnp.where(vtt[None, :], dot, -jnp.inf)
+                tile_val = jnp.max(dot, axis=1)
+                tile_arg = jnp.argmax(dot, axis=1).astype(jnp.int32) + t * tile
+                take_new = tile_val > bv
+                return (
+                    jnp.where(take_new, tile_val, bv),
+                    jnp.where(take_new, tile_arg, bi),
+                )
+
+            carry = lax.cond(can_skip, lambda c: c, compute, carry)
+            return carry, None
+
+        init = (
+            jnp.full(xb.shape[:1], -jnp.inf, jnp.float32),
+            jnp.zeros(xb.shape[:1], jnp.int32),
+        )
+        (bv, bi), _ = lax.scan(body, init, (jnp.arange(n_tiles), ct, sct, vt))
+        return bv, bi
+
+    vals, idxs = lax.map(per_block, (Xb, sxb))
+    return idxs.reshape(-1), vals.reshape(-1)
+
+
+def flash_norm_cache(X, metric=DistanceType.L2Expanded):
+    """Precompute the per-dataset arrays the flash E step reuses across EM
+    iterations: for cosine the unit rows (plus their norms), otherwise the
+    squared norms and norms of ``X``. Pass the result to
+    :func:`flash_min_cluster_and_distance` as ``cache=``."""
+    metric = resolve_metric(metric)
+    X = jnp.asarray(X, jnp.float32)
+    if metric == DistanceType.CosineExpanded:
+        xu = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12)
+        xn = row_norms(xu)
+        return (xu, xn, jnp.sqrt(xn))
+    xn = row_norms(X)
+    return (X, xn, jnp.sqrt(xn))
+
+
+def flash_min_cluster_and_distance(
+    X,
+    centroids,
+    metric=DistanceType.L2Expanded,
+    cache=None,
+    row_block: int = 1024,
+    center_tile: int = 512,
+):
+    """Drop-in, bit-identical replacement for
+    :func:`min_cluster_and_distance` built on the flash blocked/bounded
+    assignment. ``cache`` (from :func:`flash_norm_cache`) amortizes the
+    sample-side norms across repeated calls on the same ``X``."""
+    metric = resolve_metric(metric)
+    if cache is None:
+        cache = flash_norm_cache(X, metric)
+    Xc, xn, sx = cache
+    n, d = Xc.shape
+    c = jnp.asarray(centroids, jnp.float32)
+    k = c.shape[0]
+
+    block = int(min(row_block, max(8, n)))
+    nb = cdiv(n, block)
+    rpad = nb * block - n
+    if rpad:
+        Xc = jnp.pad(Xc, ((0, rpad), (0, 0)))
+        xn = jnp.pad(xn, (0, rpad))
+        sx = jnp.pad(sx, (0, rpad))
+    Xb = Xc.reshape(nb, block, d)
+    xnb = xn.reshape(nb, block)
+    sxb = sx.reshape(nb, block)
+
+    tile = int(min(center_tile, max(128, k)))
+    nt = cdiv(k, tile)
+    cpad = nt * tile - k
+    cp = jnp.pad(c, ((0, cpad), (0, 0))) if cpad else c
+    ct = cp.reshape(nt, tile, d)
+
+    if metric == DistanceType.InnerProduct:
+        sct = jnp.sqrt(row_norms(cp)).reshape(nt, tile)
+        valid = (jnp.arange(nt * tile) < k).reshape(nt, tile)
+        labels, vals = _flash_assign_ip(Xb, sxb, ct, sct, valid, tile=tile)
+        return labels[:n], vals[:n]
+
+    if metric == DistanceType.CosineExpanded:
+        cu = cp / jnp.maximum(jnp.linalg.norm(cp, axis=1, keepdims=True), 1e-12)
+        cn = row_norms(cu)
+        cn = jnp.where(jnp.arange(nt * tile) < k, cn, jnp.inf)
+        labels, vals = _flash_assign_l2(
+            Xb, xnb, sxb, cu.reshape(nt, tile, d), cn.reshape(nt, tile),
+            jnp.sqrt(cn).reshape(nt, tile), tile=tile, sqrt=False,
+        )
+        return labels[:n], 0.5 * vals[:n]  # ||x̂-ĉ||²/2 == 1 - cos
+
+    cn = row_norms(cp)
+    cn = jnp.where(jnp.arange(nt * tile) < k, cn, jnp.inf)
+    sqrt = metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
+    labels, vals = _flash_assign_l2(
+        Xb, xnb, sxb, ct, cn.reshape(nt, tile), jnp.sqrt(cn).reshape(nt, tile),
+        tile=tile, sqrt=sqrt,
+    )
+    return labels[:n], vals[:n]
+
+
+def _flash_lloyd(X, init_centers, k: int, metric, max_iter: int, tol: float, weights) -> KMeansOutput:
+    """Flash-KMeans Lloyd: same ``while_loop`` cond/body semantics as
+    :func:`_lloyd` with the E step swapped for the cached/blocked/bounded
+    assignment — bit-compatible convergence, less work per iteration."""
+    n = X.shape[0]
+    tol2 = jnp.float32(tol * tol)
+    cache = flash_norm_cache(X, metric)  # hoisted out of the EM loop
+
+    def assign(centers):
+        return flash_min_cluster_and_distance(X, centers, metric=metric, cache=cache)
+
+    def cond(carry):
+        _, _, it, shift2, _ = carry
+        return (it < max_iter) & (shift2 > tol2)
+
+    def body(carry):
+        centers, _, it, _, _ = carry
+        labels, dists = assign(centers)
+        new_centers, _ = _update_centroids(X, labels, k, centers, weights)
+        shift2 = jnp.sum((new_centers - centers) ** 2)
+        inertia = jnp.sum(weights * dists)
+        return new_centers, labels, it + 1, shift2, inertia
+
+    init = (
+        init_centers,
+        jnp.zeros((n,), jnp.int32),
+        jnp.int32(0),
+        jnp.float32(jnp.inf),
+        jnp.float32(jnp.inf),
+    )
+    centers, labels, n_iter, _, _ = lax.while_loop(cond, body, init)
+    labels, dists = assign(centers)
+    return KMeansOutput(
+        centroids=centers, labels=labels, inertia=jnp.sum(weights * dists), n_iter=n_iter
+    )
+
+
 def fit(
     X,
     params: Optional[KMeansParams] = None,
@@ -125,6 +371,12 @@ def fit(
         params.init != "array" or centroids is not None,
         "init='array' requires an explicit centroids argument",
     )
+    expects(
+        params.algorithm in ("lloyd", "flash"),
+        "algorithm must be 'lloyd' or 'flash', got %s",
+        params.algorithm,
+    )
+    lloyd_fn = _flash_lloyd if params.algorithm == "flash" else _lloyd
     weights = (
         jnp.ones((n,), jnp.float32)
         if sample_weights is None
@@ -157,9 +409,11 @@ def fit(
                 init_centers = kmeans_plus_plus(kinit, X, k, sample_weights)
             sp.sync(init_centers)
 
-        with obs.span("kmeans.fit.lloyd", k=k, n=n, trial=trial) as sp:
+        with obs.span(
+            "kmeans.fit.lloyd", k=k, n=n, trial=trial, algorithm=params.algorithm
+        ) as sp:
             out = sp.sync(
-                _lloyd(X, init_centers, k, metric, params.max_iter, params.tol, weights)
+                lloyd_fn(X, init_centers, k, metric, params.max_iter, params.tol, weights)
             )
         if obs.is_enabled():
             obs.observe("kmeans.fit.n_iter", float(out.n_iter))

@@ -65,10 +65,17 @@ def _weighted_lloyd(X, weights, init_centers, *, k: int, metric, n_iters: int):
     Jitted with a static ``k``: callers must pad every run to one shared
     ``k`` (see ``fit``) so the whole fine-cluster phase compiles ONCE —
     per-mesocluster shapes would otherwise retrace/recompile for each of
-    the ~√k mesoclusters (~10 min of compile at 1M-scale builds)."""
+    the ~√k mesoclusters (~10 min of compile at 1M-scale builds).
+
+    The E step is the Flash-KMeans cached/blocked assignment (bit-identical
+    to ``min_cluster_and_distance``) with the sample norms hoisted out of
+    the iteration loop."""
+    from raft_tpu.cluster.kmeans import flash_min_cluster_and_distance, flash_norm_cache
+
+    cache = flash_norm_cache(X, metric)
 
     def body(_, centers):
-        labels, _ = min_cluster_and_distance(X, centers, metric=metric)
+        labels, _ = flash_min_cluster_and_distance(X, centers, metric=metric, cache=cache)
         w = weights
         sums = jax.ops.segment_sum(X * w[:, None], labels, num_segments=k)
         counts = jax.ops.segment_sum(w, labels, num_segments=k)
@@ -101,12 +108,22 @@ def _adjust_centers(key, X, centers, labels, counts, threshold: float):
 
 def _em_iters(key, X, centers, k: int, metric, n_iters: int, threshold: float):
     """Balancing EM (``balancing_em_iters``, ``kmeans_balanced.cuh:615``):
-    assignment + mean update + center adjustment, fully on-device."""
+    assignment + mean update + center adjustment, fully on-device. The
+    assignment is the Flash-KMeans cached/blocked E step (bit-identical to
+    ``min_cluster_and_distance``) with the full-dataset norms computed once
+    for all ``n_iters`` EM passes — this is the build-time hot loop of
+    every IVF coarse training run."""
+    from raft_tpu.cluster.kmeans import flash_min_cluster_and_distance, flash_norm_cache
+
+    cache = flash_norm_cache(X, metric)
+
+    def assign(c):
+        return flash_min_cluster_and_distance(X, c, metric=metric, cache=cache)
 
     def body(i, carry):
         centers, kk = carry
         kk, kadj = jax.random.split(kk)
-        labels, _ = min_cluster_and_distance(X, centers, metric=metric)
+        labels, _ = assign(centers)
         sums = jax.ops.segment_sum(X, labels, num_segments=k)
         counts = jax.ops.segment_sum(jnp.ones((X.shape[0],), jnp.float32), labels, num_segments=k)
         means = sums / jnp.maximum(counts[:, None], 1.0)
@@ -117,7 +134,7 @@ def _em_iters(key, X, centers, k: int, metric, n_iters: int, threshold: float):
     centers, _ = lax.fori_loop(0, n_iters, body, (centers, key))
     # Final pure-mean pass (no adjustment) so returned centers are the means
     # of their final assignments.
-    labels, _ = min_cluster_and_distance(X, centers, metric=metric)
+    labels, _ = assign(centers)
     sums = jax.ops.segment_sum(X, labels, num_segments=k)
     counts = jax.ops.segment_sum(jnp.ones((X.shape[0],), jnp.float32), labels, num_segments=k)
     means = sums / jnp.maximum(counts[:, None], 1.0)

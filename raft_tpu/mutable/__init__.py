@@ -11,7 +11,7 @@ publishes the next generation (:mod:`~raft_tpu.mutable.compact`,
 from raft_tpu.mutable.compact import compact
 from raft_tpu.mutable.manifest import Manifest
 from raft_tpu.mutable.segments import MutableIndex, Snapshot
-from raft_tpu.mutable.wal import WalRecord, WriteAheadLog, replay
+from raft_tpu.mutable.wal import WalRecord, WriteAheadLog, replay, segment_paths
 
 __all__ = [
     "Manifest",
@@ -21,4 +21,5 @@ __all__ = [
     "WriteAheadLog",
     "compact",
     "replay",
+    "segment_paths",
 ]

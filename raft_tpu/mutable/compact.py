@@ -116,7 +116,8 @@ def compact(mut: "seg.MutableIndex", res=None) -> int:
             if mut.wal is not None:
                 mut.wal.close()
             mut.wal, _ = seg.WriteAheadLog.open(
-                os.path.join(mut.directory, seg._wal_name(new_gen))
+                os.path.join(mut.directory, seg._wal_name(new_gen)),
+                max_bytes=mut.max_wal_bytes,
             )
             _cleanup_old_generation(mut.directory, old_gen, old_wal_path)
 
@@ -137,7 +138,11 @@ def _cleanup_old_generation(directory: str, old_gen: int, old_wal_path) -> None:
         old_dir = os.path.join(directory, seg._gen_dirname(old_gen))
         if os.path.isdir(old_dir):
             shutil.rmtree(old_dir)
-        if old_wal_path and os.path.exists(old_wal_path):
-            os.unlink(old_wal_path)
+        if old_wal_path:
+            from raft_tpu.mutable.wal import segment_paths
+
+            # the base file plus every rotated .NNNNNN segment
+            for sp in segment_paths(old_wal_path):
+                os.unlink(sp)
     except OSError:  # graft-lint: ignore[silent-except] — orphan cleanup is advisory
         pass

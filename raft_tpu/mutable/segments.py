@@ -48,6 +48,29 @@ from raft_tpu.ops.distance import DistanceType, is_min_close, resolve_metric
 
 ALGOS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
 
+#: delta-scan routing knobs accepted by ``delta_mode``
+DELTA_MODES = ("auto", "exact", "fused")
+
+#: The fused delta scan is lossless only while every merge bank holds a
+#: single 128-lane group (see ``ops.pallas.ivf_scan._seg_compress``):
+#: with the ``bank8`` merge that caps the padded delta at 8 * 128 rows,
+#: so routing through the kernel keeps *bitwise* candidate parity with
+#: the exact XLA scan rather than the approximate-top-k semantics the
+#: big fused indexes accept.
+_DELTA_FUSED_MAX_ROWS = 1024
+_DELTA_FUSED_QT = 128
+
+#: metrics whose fused-kernel epilogue matches brute-force exact
+#: distances term-for-term (cosine divides by the norm product on the
+#: XLA path but multiplies by rsqrt in-kernel — not bit-comparable)
+_DELTA_FUSED_METRICS = frozenset(
+    {
+        DistanceType.L2Expanded,
+        DistanceType.L2SqrtExpanded,
+        DistanceType.InnerProduct,
+    }
+)
+
 #: initial delta-buffer capacity (rows); grows by doubling
 _DELTA_MIN_CAP = 64
 
@@ -100,6 +123,95 @@ def _search_main(algo: str, index, queries, k: int, params, prefilter, dataset, 
     raise ValueError(f"unknown mutable algo {algo!r}")
 
 
+def _delta_fused_eligible(metric, cap: int, k: int) -> bool:
+    """True when the single-list fused kernel reproduces the exact scan
+    bit-for-bit: a supported metric, the padded delta within the
+    lossless bank-merge window, and k within one extract width."""
+    return metric in _DELTA_FUSED_METRICS and cap <= _DELTA_FUSED_MAX_ROWS and k <= 128
+
+
+def _delta_route(mode: str, metric, cap: int, k: int) -> str:
+    """Resolve ``delta_mode`` to the scan that actually runs."""
+    expects(mode in DELTA_MODES, "delta_mode must be %s, got %r",
+            "|".join(DELTA_MODES), mode)
+    if mode == "exact":
+        return "exact"
+    eligible = _delta_fused_eligible(metric, cap, k)
+    if mode == "fused":
+        expects(
+            eligible,
+            "delta_mode='fused' needs an L2/IP metric, a delta of <= %d "
+            "(padded) rows and k <= 128",
+            _DELTA_FUSED_MAX_ROWS,
+        )
+        return "fused"
+    import jax
+
+    return "fused" if eligible and jax.default_backend() == "tpu" else "exact"
+
+
+def _delta_fused_search(metric, delta_bf, delta_live, queries, k: int):
+    """Delta scan through the fused Pallas probed-list kernel, treating
+    the padded delta buffer as ONE list that every query tile probes.
+
+    Within the eligibility window (:func:`_delta_fused_eligible`) the
+    kernel's lane-group compression is a pure reshuffle — no candidate
+    is ever merged away — and its distance epilogue applies the same
+    expanded-metric terms as :func:`raft_tpu.neighbors.brute_force.search`
+    ``mode="exact"``, so ids match exactly and distances to float
+    rounding (the parity gate in ``tests/test_mutable.py``). Dead and
+    padding rows fold into the slot validity the same way the live
+    bitset masks the exact scan.
+    """
+    import jax
+
+    from raft_tpu.ops.pallas.ivf_scan import fused_list_topk
+
+    cap = int(delta_bf.size)
+    qf = jnp.asarray(queries, jnp.float32)
+    nq = qf.shape[0]
+    qt = _DELTA_FUSED_QT
+    n_qt = max(1, (nq + qt - 1) // qt)
+    nq_pad = n_qt * qt
+    if nq_pad != nq:
+        qf = jnp.concatenate(
+            [qf, jnp.broadcast_to(qf[:1], (nq_pad - nq, qf.shape[1]))]
+        )
+    mask = (
+        jnp.asarray(delta_live.to_mask())
+        if delta_live is not None
+        else jnp.ones((cap,), bool)
+    )
+    positions = jnp.arange(cap, dtype=jnp.int32)
+    list_indices = jnp.where(mask, positions, -1)[None, :]
+    tile_probes = jnp.zeros((n_qt, 1), jnp.int32)
+    probe_valid = jnp.ones((n_qt, 1), jnp.int32)
+    norms = delta_bf.norms
+    vals, slots = fused_list_topk(
+        delta_bf.dataset[None].astype(jnp.float32),
+        norms[None] if norms is not None else None,
+        list_indices,
+        qf,
+        tile_probes,
+        probe_valid,
+        k=k,
+        metric=metric,
+        qt=qt,
+        merge="bank8",
+        interpret=jax.default_backend() != "tpu",
+    )
+    idx = jnp.where(slots >= 0, slots, -1)
+    if metric == DistanceType.InnerProduct:
+        out = -vals
+    else:
+        qn = jnp.sum(qf * qf, axis=1)
+        out = jnp.maximum(qn[:, None] + vals, 0.0)
+        if metric == DistanceType.L2SqrtExpanded:
+            out = jnp.sqrt(out)
+        out = jnp.where(idx >= 0, out, jnp.inf)
+    return out[:nq], idx[:nq]
+
+
 def _save_rows(path: str, ids: np.ndarray, data: np.ndarray) -> str:
     """Atomic checksummed sidecar with the main segment's source rows
     (the rebuild input future compactions need — PQ codes are lossy)."""
@@ -150,6 +262,7 @@ class Snapshot:
     n_delta_live: int
     search_params: object = None
     search_kwargs: Dict[str, object] = dataclasses.field(default_factory=dict)
+    delta_mode: str = "auto"  # auto | exact | fused (see _delta_route)
 
     @property
     def size(self) -> int:
@@ -200,10 +313,26 @@ class Snapshot:
             from raft_tpu.neighbors import brute_force
 
             k_delta = min(k, int(self.delta_bf.size))
-            d, p = brute_force.search(
-                self.delta_bf, queries, k_delta,
-                prefilter=self.delta_live, mode="exact",
+            route = _delta_route(
+                self.delta_mode, self.metric, int(self.delta_bf.size), k_delta
             )
+            d = p = None
+            if route == "fused":
+                from raft_tpu.robust.fallback import FALLBACK_ERRORS
+
+                try:
+                    d, p = _delta_fused_search(
+                        self.metric, self.delta_bf, self.delta_live, queries, k_delta
+                    )
+                except FALLBACK_ERRORS:
+                    route = "exact"  # kernel failure degrades to the XLA scan
+            if d is None:
+                d, p = brute_force.search(
+                    self.delta_bf, queries, k_delta,
+                    prefilter=self.delta_live, mode="exact",
+                )
+            if obs.is_enabled():
+                obs.inc("mutable.delta.scans", mode=route)
             d = np.asarray(d, np.float32)
             p = np.asarray(p)
             ids = np.where(p >= 0, self.delta_ids[np.clip(p, 0, None)], np.int64(-1))
@@ -254,15 +383,23 @@ class MutableIndex:
         search_params=None,
         metric=None,
         name: Optional[str] = None,
+        max_wal_bytes: Optional[int] = None,
+        delta_mode: str = "auto",
     ):
         expects(algo in ALGOS, "unknown mutable algo %r (want one of %s)",
                 algo, ", ".join(ALGOS))
         expects(dim >= 1, "dim must be >= 1")
+        expects(delta_mode in DELTA_MODES, "delta_mode must be %s, got %r",
+                "|".join(DELTA_MODES), delta_mode)
+        expects(max_wal_bytes is None or max_wal_bytes > 0,
+                "max_wal_bytes must be positive when set")
         self.algo = algo
         self.dim = int(dim)
         self.directory = directory
         self.index_params = index_params
         self.search_params = search_params
+        self.max_wal_bytes = max_wal_bytes
+        self.delta_mode = delta_mode
         if metric is None:
             metric = getattr(index_params, "metric", DistanceType.L2Expanded)
         self.metric = resolve_metric(metric)
@@ -303,6 +440,8 @@ class MutableIndex:
         search_params=None,
         metric=None,
         name: Optional[str] = None,
+        max_wal_bytes: Optional[int] = None,
+        delta_mode: str = "auto",
         res=None,
     ) -> "MutableIndex":
         """Open (or create) the mutable index at ``directory``.
@@ -312,10 +451,14 @@ class MutableIndex:
         checksummed v4 path, and the generation's WAL replays on top —
         any valid prefix of a torn log recovers cleanly, so a crash at
         any point yields either the pre- or post-mutation state.
+        ``max_wal_bytes`` arms size-triggered WAL segment rotation;
+        ``delta_mode`` routes delta-segment scans (see
+        :func:`_delta_route`).
         """
         self = cls(
             algo, dim, directory=directory, index_params=index_params,
             search_params=search_params, metric=metric, name=name,
+            max_wal_bytes=max_wal_bytes, delta_mode=delta_mode,
         )
         m = man.read(directory)
         if m is None:
@@ -335,7 +478,9 @@ class MutableIndex:
                 self.main_index = _load_main(
                     algo, os.path.join(directory, m.main), data, res=res
                 )
-        self.wal, records = WriteAheadLog.open(os.path.join(directory, m.wal))
+        self.wal, records = WriteAheadLog.open(
+            os.path.join(directory, m.wal), max_bytes=self.max_wal_bytes
+        )
         for rec in records:
             self._apply(rec)
         self._note_obs()
@@ -544,6 +689,7 @@ class MutableIndex:
                 delta_live=delta_live,
                 n_delta_live=self._n_delta - self._n_delta_dead,
                 search_params=self.search_params,
+                delta_mode=self.delta_mode,
             )
             self._snap = snap
             return snap

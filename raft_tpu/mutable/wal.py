@@ -27,6 +27,19 @@ here loses the mutation entirely: pre-state on recovery) and
 caller never saw it applied: post-state on recovery). Both outcomes
 are legal; a *mixed* state is not, and ``tests/test_mutable.py`` kills
 at both stages for every mutation kind to prove it.
+
+Segment rotation (``max_bytes``): a long-lived generation would
+otherwise grow one unbounded log file whose full replay cost every
+reopen pays. When ``max_bytes`` is set, :meth:`WriteAheadLog.append`
+rotates at a *frame boundary* — the active segment is sealed
+(flush + fsync + close) and a fresh ``<path>.NNNNNN`` segment opens —
+whenever the next frame would push the segment past the limit (a
+single oversized frame still lands whole; frames are never split).
+:meth:`WriteAheadLog.open` replays all segments in sequence order.
+Only the *last* (active) segment may legally carry a torn tail —
+sealed segments were fsync'd before rotation — so a tear found in a
+sealed segment orphans every later segment (they were written after
+the tear and are outside the longest-valid-prefix contract).
 """
 from __future__ import annotations
 
@@ -78,6 +91,35 @@ class WalRecord:
         return WalRecord(op=op, ids=ids, vectors=vectors)
 
 
+def _segment_path(path: str, seq: int) -> str:
+    """Segment ``seq`` of the log rooted at ``path``: the base file is
+    segment 0 (backwards compatible with pre-rotation logs), rotations
+    append ``.000001``, ``.000002``, ..."""
+    return path if seq == 0 else f"{path}.{seq:06d}"
+
+
+def _list_segments(path: str) -> List[Tuple[int, str]]:
+    """All on-disk segments of the log at ``path`` in sequence order.
+    Always includes segment 0 (even when the file does not exist yet) so
+    callers have an active segment to create."""
+    seqs = {0}
+    parent = os.path.dirname(os.path.abspath(path))
+    base = os.path.basename(path)
+    if os.path.isdir(parent):
+        for name in os.listdir(parent):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1 :]
+                if suffix.isdigit():
+                    seqs.add(int(suffix))
+    return [(s, _segment_path(path, s)) for s in sorted(seqs)]
+
+
+def segment_paths(path: str) -> List[str]:
+    """Existing segment files of the log at ``path`` (for cleanup when a
+    generation is superseded)."""
+    return [sp for _, sp in _list_segments(path) if os.path.exists(sp)]
+
+
 def replay(path: str) -> Tuple[List[WalRecord], int]:
     """Read the longest valid prefix of the log at ``path``.
 
@@ -125,40 +167,103 @@ class WriteAheadLog:
     tail, and positions the write cursor for appends.
     """
 
-    def __init__(self, path: str, fh: BinaryIO, offset: int):
-        self.path = path
+    def __init__(
+        self,
+        path: str,
+        fh: BinaryIO,
+        offset: int,
+        max_bytes: Optional[int] = None,
+        seq: int = 0,
+    ):
+        self.path = path  # base path; the active segment is _segment_path(path, seq)
         self._fh = fh
-        self._offset = offset
+        self._offset = offset  # write cursor within the active segment
+        self._max_bytes = max_bytes
+        self._seq = seq
 
     @classmethod
-    def open(cls, path: str) -> Tuple["WriteAheadLog", List[WalRecord]]:
+    def open(
+        cls, path: str, max_bytes: Optional[int] = None
+    ) -> Tuple["WriteAheadLog", List[WalRecord]]:
         """Open (creating if missing) the log at ``path``; returns the
-        log plus the records recovered from its valid prefix."""
-        records, good = replay(path)
+        log plus the records recovered from its valid prefix. Rotated
+        segments replay in sequence order; only the last may carry a
+        torn tail (it is truncated away) — a tear in a *sealed* segment
+        stops recovery there and unlinks the later, orphaned segments.
+        ``max_bytes`` arms size-triggered rotation for future appends."""
+        segments = _list_segments(path)
+        records: List[WalRecord] = []
+        seq, seg_path, good = segments[0][0], segments[0][1], 0
+        for i, (sq, sp) in enumerate(segments):
+            recs, sp_good = replay(sp)
+            records.extend(recs)
+            seq, seg_path, good = sq, sp, sp_good
+            size = os.path.getsize(sp) if os.path.exists(sp) else 0
+            if sp_good != size and i != len(segments) - 1:
+                for _, orphan in segments[i + 1 :]:
+                    try:
+                        os.unlink(orphan)
+                    except OSError:  # graft-lint: ignore[silent-except] — orphan cleanup is advisory
+                        pass
+                break
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         # "a+b" creates when missing; reopen r+b to truncate a torn tail
-        fh = open(path, "a+b")
+        fh = open(seg_path, "a+b")
         fh.seek(0, os.SEEK_END)
         if fh.tell() != good:
             fh.close()
-            fh = open(path, "r+b")
+            fh = open(seg_path, "r+b")
             fh.truncate(good)
             fh.seek(good)
             fh.flush()
             os.fsync(fh.fileno())
         if obs.is_enabled() and records:
             obs.inc("mutable.wal.replayed", float(len(records)))
-        return cls(path, fh, good), records
+        return cls(path, fh, good, max_bytes=max_bytes, seq=seq), records
 
     @property
     def offset(self) -> int:
         return self._offset
 
+    @property
+    def segment(self) -> int:
+        """Sequence number of the active segment."""
+        return self._seq
+
+    def segment_paths(self) -> List[str]:
+        """Existing segment files of this log, sequence order."""
+        return segment_paths(self.path)
+
+    def _rotate(self) -> None:
+        """Seal the active segment and start the next one. Called only
+        at a frame boundary, so the sealed file ends on a whole record;
+        the directory entry for the new segment is fsync'd so a crash
+        right after rotation recovers the sealed prefix plus an empty
+        (or torn-tail-truncated) active segment — never a gap."""
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        self._seq += 1
+        nxt = _segment_path(self.path, self._seq)
+        fh = open(nxt, "a+b")
+        fh.seek(0, os.SEEK_END)
+        dfd = os.open(os.path.dirname(os.path.abspath(nxt)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        self._fh = fh
+        self._offset = fh.tell()
+        if obs.is_enabled():
+            obs.inc("mutable.wal.rotations")
+            obs.set_gauge("mutable.wal.segments", float(self._seq + 1))
+
     def append(self, record: WalRecord) -> int:
         """Make ``record`` durable (write + flush + fsync); returns the
-        offset past the appended frame. The caller applies the mutation
-        to the in-memory segments only after this returns."""
+        offset past the appended frame within the active segment. The
+        caller applies the mutation to the in-memory segments only
+        after this returns."""
         expects(record.op in OPS, "unknown WAL op %r", record.op)
         payload = record.encode()
         frame = _HEADER.pack(_REC_MAGIC, len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
@@ -167,6 +272,12 @@ class WriteAheadLog:
         from raft_tpu.robust import faults
 
         faults.fire("wal.append", op=record.op, stage="pre")
+        if (
+            self._max_bytes is not None
+            and self._offset > 0
+            and self._offset + len(frame) > self._max_bytes
+        ):
+            self._rotate()
         self._fh.seek(self._offset)
         self._fh.write(frame)
         self._fh.flush()

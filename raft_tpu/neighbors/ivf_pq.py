@@ -45,6 +45,22 @@ TPU-first redesign:
   ``detail/ivf_pq_codepacking.cuh`` analog — plain contiguous bytes, not
   16-byte interleave: TPU DMA wants flat rows), cutting code storage and
   scan DMA to ``pq_bits/8`` of a byte per code.
+* ``pq_kind="rabitq"`` (round 7): **RaBitQ binary quantization** ("GPU-
+  Native Approximate Nearest Neighbor Search with IVF-RaBitQ",
+  PAPERS.md) — each list residual is reduced to its D sign bits under a
+  FORCED random rotation plus two per-vector f32 corrections, and scored
+  with the unbiased bitwise estimator
+  ``est = ||q-c||^2 + ||r||^2 - g*(b.q_rot - Σq_rot/2) + const(b, c)``
+  where ``g = 4||r|| / (sqrt(D) * <o, x̄>)`` folds the estimator's
+  normalization. One bit per dimension (16 bytes/row at d=128 — the same
+  DMA footprint as the nibble config) but the scan's per-row decode is a
+  single D-wide sign matmul instead of a ``pq_dim * ksub``-column
+  multi-hot decode: ~4x cheaper per scanned row at equal bits. The
+  center-dependent part of the estimator is folded into the per-slot
+  constant channel (``rot_sqnorms`` stores it; ``corrections`` stores
+  ``g``) so the fused kernel's bit matmul is query-only. Rescoring runs
+  through the same integrated ``refine`` re-rank; see
+  :mod:`raft_tpu.ops.pallas.rabitq_scan` for the fused Pallas path.
 
 Supported metrics: L2Expanded, L2SqrtExpanded, InnerProduct.
 """
@@ -122,10 +138,15 @@ class IvfPqIndexParams:
     # semantics). "nibble" = additive nibble pairs (requires pq_bits=8,
     # per_subspace): subspace j is quantized by A[j][hi] + B[j][lo] — 256
     # effective centers whose fused-scan LUT costs only 32 columns.
-    # "auto" (default) = "nibble" whenever representable (pq_bits=8 +
-    # per_subspace — i.e. the out-of-box config), else "kmeans": the
-    # nibble+refine operating point is the measured Pareto frontier
-    # (BENCH_r05: 15.7k QPS @ 0.947 vs 4.6k @ 0.56 for kmeans-256).
+    # "rabitq" = 1-bit RaBitQ sign codes with per-vector correction
+    # factors (pq_bits is forced to 1; pq_dim/codebook knobs are ignored;
+    # the rotation is always random — the estimator's guarantees need it).
+    # "auto" (default) = "rabitq" when pq_bits=1 is requested, else
+    # "nibble" whenever representable (pq_bits=8 + per_subspace — i.e.
+    # the out-of-box config), else "kmeans": the nibble+refine operating
+    # point was the measured Pareto frontier (BENCH_r05: 15.7k QPS
+    # @ 0.947 vs 4.6k @ 0.56 for kmeans-256); rabitq+refine beats it at
+    # equal code bytes (BENCH_r06).
     pq_kind: str = "auto"
 
 
@@ -185,6 +206,8 @@ class IvfPqIndex:
     list_indices: jax.Array  # [n_lists, max_list] i32, -1 = empty
     list_sizes: jax.Array  # [n_lists] i32
     rot_sqnorms: jax.Array  # [n_lists, max_list] f32 ||c_rot + resid||^2
+    #   rabitq: the per-slot additive constant of the distance estimator
+    #   (center-dependent terms folded at build time; see _rabitq docs).
     metric: DistanceType
     codebook_kind: str
     pq_bits: int
@@ -193,6 +216,8 @@ class IvfPqIndex:
     additive: bool = False  # nibble-pair codebooks (pq_kind="nibble")
     packed: bool = False  # 4-bit codes packed two per byte
     center_rank: Optional[jax.Array] = None  # [n_lists] spatial rank (v3+)
+    rabitq: bool = False  # 1-bit sign codes + corrections (pq_kind="rabitq")
+    corrections: Optional[jax.Array] = None  # [n_lists, max_list] f32 rabitq g
 
     def tree_flatten(self):
         return (
@@ -206,10 +231,11 @@ class IvfPqIndex:
                 self.list_sizes,
                 self.rot_sqnorms,
                 self.center_rank,
+                self.corrections,
             ),
             (
                 self.metric, self.codebook_kind, self.pq_bits, self.size,
-                self.list_cap_factor, self.additive, self.packed,
+                self.list_cap_factor, self.additive, self.packed, self.rabitq,
             ),
         )
 
@@ -225,6 +251,8 @@ class IvfPqIndex:
             additive=aux[5],
             packed=aux[6],
             center_rank=children[8],
+            rabitq=aux[7],
+            corrections=children[9],
         )
 
     @property
@@ -516,6 +544,73 @@ def _encode_all(ds_f32, labels, centers, rotation, pq_centers, pq_dim, per_clust
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
 
 
+@functools.partial(jax.jit, static_argnames=("metric",))
+def _rabitq_encode_chunk(X, labels, centers, rotation, centers_rot, *, metric):
+    """RaBitQ-encode a chunk of rows against their lists' centers.
+
+    Per row with rotated residual ``r = R(x - c_l)`` (``R`` orthonormal,
+    ``D = rot_dim``), the stored code is the D sign bits ``b = [r > 0]``
+    (the quantized direction is ``x̄ = (2b-1)/sqrt(D)``, a unit vector) and
+    the RaBitQ estimator of ``<r/||r||, u>`` for any query-side ``u`` is
+    ``<x̄, u> / <x̄, o>`` with ``o = r/||r||``. Expanding ``<x̄, u> =
+    (2 b·u - Σu)/sqrt(D)`` and folding every center-dependent term at
+    build time gives one per-slot affine form shared by both metrics:
+
+        min-score      = C1 - coef·(q·c_l) - g·(b·q_rot - Σq_rot/2)
+        L2   estimate  = ||q||² + min-score          (coef = 2)
+        IP   estimate  = -min-score                  (coef = 1)
+
+    with the two per-row scalars stored in the index:
+
+        g_L2 = 4||r|| / (sqrt(D)·<x̄,o>)      g_IP = g_L2 / 2
+        C1_L2 = ||c_rot||² + ||r||² + g_L2·(b·c_rot - Σc_rot/2)
+        C1_IP = 0
+
+    (``<x̄, o> = ||r||₁ / (sqrt(D)·||r||₂)``, computable from the residual
+    alone.) Returns ``(packed_bits [c, D/8] u8, aux [c, 2] f32)`` with
+    ``aux = [C1, g]``.
+    """
+    rr = (X - centers[labels]) @ rotation.T  # [c, D]
+    D = rr.shape[1]
+    r2 = jnp.sum(rr * rr, axis=1)
+    r = jnp.sqrt(r2)
+    sd = lax.rsqrt(jnp.float32(D))
+    # <x̄, o> = sd * ||r||1 / ||r||2, in [sd, 1]; guard the zero residual.
+    ood = sd * jnp.sum(jnp.abs(rr), axis=1) / jnp.maximum(r, 1e-30)
+    g = jnp.where(r > 0, 4.0 * r * sd / jnp.maximum(ood, 1e-12), 0.0)
+    if metric == DistanceType.InnerProduct:
+        g = 0.5 * g
+    signs = (rr > 0).astype(jnp.uint8)  # [c, D]
+    crot = centers_rot[labels]  # [c, D]
+    if metric == DistanceType.InnerProduct:
+        # IP decomposes <x,q> = <c,q> + <r, q_rot>: no center term inside
+        # the estimator argument, so the additive constant is zero.
+        c1 = jnp.zeros_like(g)
+    else:
+        bdotc = jnp.sum(jnp.where(rr > 0, crot, 0.0), axis=1)
+        c1 = jnp.sum(crot * crot, axis=1) + r2 + g * (bdotc - 0.5 * jnp.sum(crot, axis=1))
+    return pack_codes_bits(signs, 1), jnp.stack([c1, g], axis=1)
+
+
+def _rabitq_encode_all(ds_f32, labels, centers, rotation, centers_rot, metric, chunk=65536):
+    """Chunked :func:`_rabitq_encode_chunk` over the full dataset."""
+    n = ds_f32.shape[0]
+    D = rotation.shape[0]
+    codes, auxs = [], []
+    for s in range(0, n, chunk):
+        cod, aux = _rabitq_encode_chunk(
+            ds_f32[s : s + chunk], labels[s : s + chunk], centers, rotation, centers_rot,
+            metric=metric,
+        )
+        codes.append(cod)
+        auxs.append(aux)
+    if not codes:
+        return jnp.zeros((0, D // 8), jnp.uint8), jnp.zeros((0, 2), jnp.float32)
+    if len(codes) == 1:
+        return codes[0], auxs[0]
+    return jnp.concatenate(codes, axis=0), jnp.concatenate(auxs, axis=0)
+
+
 def build(
     dataset,
     params: Optional[IvfPqIndexParams] = None,
@@ -529,19 +624,33 @@ def build(
         params = IvfPqIndexParams(**kwargs)
     metric = resolve_metric(params.metric)
     expects(metric in _SUPPORTED, "IVF-PQ does not support metric %s", metric)
-    expects(3 <= params.pq_bits <= 8, "pq_bits must be in [3, 8], got %d", params.pq_bits)
     expects(params.codebook_kind in (PER_SUBSPACE, PER_CLUSTER), "bad codebook_kind")
     expects(
-        params.pq_kind in ("auto", "kmeans", "nibble"), "pq_kind must be auto|kmeans|nibble"
+        params.pq_kind in ("auto", "kmeans", "nibble", "rabitq"),
+        "pq_kind must be auto|kmeans|nibble|rabitq",
     )
     pq_kind = params.pq_kind
     if pq_kind == "auto":  # default: nibble whenever representable
-        pq_kind = (
-            "nibble"
-            if params.pq_bits == 8 and params.codebook_kind == PER_SUBSPACE
-            else "kmeans"
-        )
+        if params.pq_bits == 1:
+            pq_kind = "rabitq"
+        else:
+            pq_kind = (
+                "nibble"
+                if params.pq_bits == 8 and params.codebook_kind == PER_SUBSPACE
+                else "kmeans"
+            )
     nibble = pq_kind == "nibble"
+    rabitq = pq_kind == "rabitq"
+    if rabitq:
+        # pq_bits is definitionally 1 (sign bit per rotated dimension);
+        # accept the dataclass default (8) or an explicit 1, reject the
+        # rest as probable configuration mistakes.
+        expects(
+            params.pq_bits in (1, 8),
+            "pq_kind='rabitq' is 1 bit/dim; pq_bits=%d conflicts", params.pq_bits,
+        )
+    else:
+        expects(3 <= params.pq_bits <= 8, "pq_bits must be in [3, 8], got %d", params.pq_bits)
     if nibble:
         expects(
             params.pq_bits == 8 and params.codebook_kind == PER_SUBSPACE,
@@ -551,11 +660,19 @@ def build(
     expects(dataset.ndim == 2, "dataset must be [n_rows, dim]")
     n, d = dataset.shape
     n_lists = min(params.n_lists, n)
-    pq_dim = params.pq_dim or _default_pq_dim(d)
-    expects(pq_dim <= d, "pq_dim=%d larger than dim=%d", pq_dim, d)
-    rot_dim = round_up(d, pq_dim)
-    pq_len = rot_dim // pq_dim
-    ksub = 1 << params.pq_bits
+    if rabitq:
+        # one sign bit per rotated dimension; the rotation pads d up to a
+        # byte-aligned D so rows pack to D/8 contiguous bytes.
+        pq_dim = round_up(d, 8)
+        rot_dim = pq_dim
+        pq_len = 1
+        ksub = 2
+    else:
+        pq_dim = params.pq_dim or _default_pq_dim(d)
+        expects(pq_dim <= d, "pq_dim=%d larger than dim=%d", pq_dim, d)
+        rot_dim = round_up(d, pq_dim)
+        pq_len = rot_dim // pq_dim
+        ksub = 1 << params.pq_bits
 
     key = as_key(params.seed)
     k_rot, k_cb = jax.random.split(key)
@@ -588,14 +705,56 @@ def build(
     center_rank = jnp.arange(n_lists, dtype=jnp.int32)
 
     # -- rotation + rotated centers ----------------------------------------
-    rotation = _make_rotation(k_rot, rot_dim, d, params.force_random_rotation)
+    # RaBitQ's estimator is only unbiased under a RANDOM rotation (the sign
+    # quantizer needs the residual direction uniformly distributed on the
+    # sphere), so rabitq always forces one.
+    rotation = _make_rotation(k_rot, rot_dim, d, params.force_random_rotation or rabitq)
     centers_rot = centers @ rotation.T
+
+    per_cluster = params.codebook_kind == PER_CLUSTER
+    if rabitq:
+        # No codebook to train: the "codebook" is the sign function.
+        # pq_centers stays a [1, 1, 1] placeholder (pq_len/ksub properties
+        # are meaningless for this kind and never consulted).
+        pq_centers = jnp.zeros((1, 1, 1), jnp.float32)
+        cand = ivf_common.topk_labels(ds_f32, centers, k=8)
+        max_list = ivf_common.choose_max_list(cand[:, 0], n, n_lists, params.list_cap_factor)
+        slot = ivf_common.assign_slots(cand, n_lists=n_lists, max_list=max_list)
+        final_labels = (slot // max_list).astype(jnp.int32)
+        codes_dev, aux_dev = _rabitq_encode_all(
+            ds_f32, final_labels, centers, rotation, centers_rot, metric
+        )
+        codes, list_indices, list_sizes = ivf_common.scatter_rows(
+            codes_dev, jnp.arange(n, dtype=jnp.int32), slot, n_lists=n_lists, max_list=max_list
+        )
+        aux, _, _ = ivf_common.scatter_rows(
+            aux_dev, jnp.arange(n, dtype=jnp.int32), slot, n_lists=n_lists, max_list=max_list
+        )
+        return IvfPqIndex(
+            centers=centers,
+            centers_rot=centers_rot,
+            rotation=rotation,
+            pq_centers=pq_centers,
+            codes=codes,
+            list_indices=list_indices,
+            list_sizes=list_sizes,
+            rot_sqnorms=aux[..., 0],
+            metric=metric,
+            codebook_kind=params.codebook_kind,
+            pq_bits=1,
+            size=n,
+            list_cap_factor=params.list_cap_factor,
+            additive=False,
+            packed=True,
+            center_rank=center_rank,
+            rabitq=True,
+            corrections=aux[..., 1],
+        )
 
     # -- codebook training on trainset residuals ---------------------------
     t_labels, _ = min_cluster_and_distance(trainset, centers, metric=DistanceType.L2Expanded)
     t_resid = _rotated_residuals(trainset, t_labels, centers, rotation, pq_dim)  # [nt, pq_dim, pq_len]
     nt = t_resid.shape[0]
-    per_cluster = params.codebook_kind == PER_CLUSTER
 
     if nibble:
         pq_centers = _train_nibble_books(t_resid, k_cb, params.kmeans_n_iters)
@@ -722,7 +881,15 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     flat_ids = index.list_indices.reshape(-1)
     n_old = int(index.size)
     keep_order = jnp.argsort(flat_ids < 0)[:n_old]
-    old_codes = index.codes_unpacked().reshape(-1, index.pq_dim)[keep_order]
+    if index.rabitq:
+        # sign-bit rows stay packed (one u8 row per vector); carry the
+        # per-row [C1, g] estimator scalars alongside.
+        old_codes = index.codes.reshape(-1, index.codes.shape[2])[keep_order]
+        old_aux = jnp.stack(
+            [index.rot_sqnorms.reshape(-1), index.corrections.reshape(-1)], axis=1
+        )[keep_order]
+    else:
+        old_codes = index.codes_unpacked().reshape(-1, index.pq_dim)[keep_order]
     old_ids = flat_ids[keep_order]
     old_l1 = (keep_order // index.max_list).astype(jnp.int32)
 
@@ -740,6 +907,32 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     )
     slot = ivf_common.assign_slots(cand, n_lists=n_lists, max_list=max_list)
     final_labels = (slot // max_list).astype(jnp.int32)
+    if index.rabitq:
+        new_codes, new_aux = _rabitq_encode_all(
+            vec_f32,
+            final_labels[n_old:],
+            index.centers,
+            index.rotation,
+            index.centers_rot,
+            index.metric,
+        )
+        all_codes = jnp.concatenate([old_codes, new_codes], axis=0)
+        all_aux = jnp.concatenate([old_aux, new_aux], axis=0)
+        codes, list_indices, list_sizes = ivf_common.scatter_rows(
+            all_codes, all_ids, slot, n_lists=n_lists, max_list=max_list
+        )
+        aux, _, _ = ivf_common.scatter_rows(
+            all_aux, all_ids, slot, n_lists=n_lists, max_list=max_list
+        )
+        return dataclasses.replace(
+            index,
+            codes=codes,
+            list_indices=list_indices,
+            list_sizes=list_sizes,
+            rot_sqnorms=aux[..., 0],
+            corrections=aux[..., 1],
+            size=index.size + n_new,
+        )
     new_codes = _encode_all(
         vec_f32,
         final_labels[n_old:],
@@ -1077,6 +1270,243 @@ def _ivf_pq_search_impl(
     return vals, idx
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "has_filter", "chunk_lists"),
+)
+def _ivf_rabitq_scan_impl(
+    centers,
+    rotation,
+    codes,
+    corrections,
+    list_indices,
+    rot_sqnorms,
+    queries,
+    filter_bits,
+    *,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    has_filter: bool,
+    chunk_lists: int,
+):
+    """Dense RaBitQ scan: the same probe/schedule skeleton as
+    :func:`_ivf_pq_scan_impl` with the one-hot decode matmul replaced by a
+    single sign-bit matmul per chunk (see :func:`_rabitq_encode_chunk` for
+    the estimator algebra)."""
+    nq, d = queries.shape
+    qf = queries.astype(jnp.float32)
+
+    with obs.span("ivf_pq.search.coarse_probe", nq=nq, n_probes=n_probes) as sp:
+        q_dot_c = qf @ centers.T  # [nq, n_lists]
+        if metric == DistanceType.InnerProduct:
+            coarse = -q_dot_c
+        else:
+            c_norm = jnp.sum(centers * centers, axis=1)
+            coarse = c_norm[None, :] - 2.0 * q_dot_c
+        n_lists = centers.shape[0]
+        probed = jnp.zeros((nq, n_lists), bool)
+        if n_probes < n_lists:
+            _, probes = select_k(coarse, n_probes, select_min=True)
+            probed = probed.at[jnp.arange(nq)[:, None], probes].set(True)
+        else:
+            probed = jnp.ones((nq, n_lists), bool)
+        sp.sync(probed)
+
+    q_rot = qf @ rotation.T  # [nq, rot_dim]
+    with obs.span("ivf_pq.search.rabitq_xla", nq=nq, k=k) as sp:
+        return sp.sync(
+            rabitq_scan_core(
+                codes, corrections, list_indices, rot_sqnorms, q_rot, q_dot_c,
+                probed, filter_bits,
+                k=k, metric=metric, has_filter=has_filter, chunk_lists=chunk_lists,
+            )
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "metric", "has_filter", "chunk_lists")
+)
+def rabitq_scan_core(
+    codes,
+    corrections,
+    list_indices,
+    rot_sqnorms,
+    q_rot,
+    q_dot_c,
+    probed,
+    filter_bits,
+    *,
+    k: int,
+    metric: DistanceType,
+    has_filter: bool,
+    chunk_lists: int,
+):
+    """Shardable RaBitQ scan core (mirrors :func:`pq_scan_core`): per
+    chunk, unpack the sign bits and evaluate the estimator as ONE
+    [nq, rot_dim] x [rot_dim, G*M] matmul plus an elementwise epilogue.
+    Keeps the maximize-score convention so the approx-top-k shortlist,
+    pad/probe penalties, and the distance epilogue are shared with the PQ
+    scan verbatim:
+
+        mscore = coef*(q.c_l) + g*(b.q_rot - sum(q_rot)/2) - C1
+        L2 out = max(||q||^2 - mscore, 0)      IP out = mscore
+    """
+    nq = q_rot.shape[0]
+    n_lists, max_list, bpr = codes.shape
+    D = q_rot.shape[1]
+
+    sq = jnp.sum(q_rot, axis=1)  # [nq]
+    coef = 1.0 if metric == DistanceType.InnerProduct else 2.0
+
+    n_chunks = n_lists // chunk_lists
+    G, M = chunk_lists, max_list
+    codes_c = codes.reshape(n_chunks, G * M, bpr)
+    ids_c = list_indices.reshape(n_chunks, G * M)
+    c1_c = rot_sqnorms.reshape(n_chunks, G * M)
+    g_c = corrections.reshape(n_chunks, G * M)
+    probed_c = probed.reshape(nq, n_chunks, G)
+    qdotc_c = jnp.moveaxis(q_dot_c.reshape(nq, n_chunks, G), 1, 0)
+
+    init = (
+        jnp.full((nq, k), -jnp.inf, jnp.float32),
+        jnp.zeros((nq, k), jnp.int32),  # flat slot ids
+    )
+
+    def body(carry, inp):
+        acc_v, acc_i = carry
+        cod, ids, c1, gg, pmask, qdc, ci = inp
+        # sign bits as f32 {0,1}: the bit dot is exact in f32 (each term is
+        # a masked add of a query lane), matching the fused kernel's
+        # arithmetic bit for bit.
+        bits = unpack_codes_bits(cod, 1, D).astype(jnp.float32)  # [G*M, D]
+        bq = jax.lax.dot_general(
+            q_rot, bits,
+            (((1,), (1,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # [nq, G*M]
+        part = gg[None, :] * (bq - 0.5 * sq[:, None]) - c1[None, :]
+        pad_pen = jnp.where(ids >= 0, 0.0, -jnp.inf)  # [G*M]
+        if has_filter:
+            word = filter_bits[jnp.clip(ids, 0, None) // 32]
+            bit = (word >> (jnp.clip(ids, 0, None) % 32).astype(jnp.uint32)) & 1
+            pad_pen = jnp.where(bit == 1, pad_pen, -jnp.inf)
+        probe_pen = jnp.where(pmask, coef * qdc, -jnp.inf)  # [nq, G]
+        score = (
+            part
+            + jnp.broadcast_to(probe_pen[:, :, None], (nq, G, M)).reshape(nq, G * M)
+            + pad_pen[None, :]
+        )
+        kk = min(max(2 * k, 16), G * M)
+        v, i = lax.approx_max_k(score, kk, recall_target=0.99)
+        nv, ni = lax.top_k(jnp.concatenate([acc_v, v], axis=1), k)
+        na = jnp.take_along_axis(
+            jnp.concatenate([acc_i, i + ci * (G * M)], axis=1), ni, axis=1
+        )
+        return (nv, na), None
+
+    xs = (
+        codes_c, ids_c, c1_c, g_c, jnp.moveaxis(probed_c, 1, 0), qdotc_c,
+        jnp.arange(n_chunks, dtype=jnp.int32),
+    )
+    (vals, slots), _ = lax.scan(body, init, xs)
+
+    idx = list_indices.reshape(-1)[slots.reshape(-1)].reshape(nq, k)
+    idx = jnp.where(jnp.isfinite(vals), idx, -1)
+    if metric == DistanceType.InnerProduct:
+        out = vals
+    else:
+        qn = jnp.sum(q_rot * q_rot, axis=1)
+        out = jnp.maximum(qn[:, None] - vals, 0.0)
+        if metric == DistanceType.L2SqrtExpanded:
+            out = jnp.sqrt(out)
+        out = jnp.where(idx >= 0, out, jnp.inf)
+    return out, idx
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_probes", "metric", "has_filter")
+)
+def _ivf_rabitq_probe_impl(
+    centers,
+    rotation,
+    codes,
+    corrections,
+    list_indices,
+    rot_sqnorms,
+    queries,
+    filter_bits,
+    *,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    has_filter: bool,
+):
+    """Probe-at-a-time RaBitQ estimator (memory-lean analog of
+    :func:`_ivf_pq_search_impl`): gathers one list per query per step and
+    evaluates the estimator with a per-query bit dot."""
+    nq, d = queries.shape
+    qf = queries.astype(jnp.float32)
+    bpr = codes.shape[2]
+    D = bpr * 8
+
+    q_dot_c = qf @ centers.T
+    if metric == DistanceType.InnerProduct:
+        coarse = -q_dot_c
+    else:
+        c_norm = jnp.sum(centers * centers, axis=1)
+        coarse = c_norm[None, :] - 2.0 * q_dot_c
+    _, probes = select_k(coarse, n_probes, select_min=True)  # [nq, n_probes]
+
+    q_rot = qf @ rotation.T  # [nq, D]
+    sq = jnp.sum(q_rot, axis=1)  # [nq]
+    qn = jnp.sum(q_rot * q_rot, axis=1)
+    coef = 1.0 if metric == DistanceType.InnerProduct else 2.0
+
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.float32(worst_value(jnp.float32, select_min))
+    init = (
+        jnp.full((nq, k), worst, jnp.float32),
+        jnp.full((nq, k), -1, jnp.int32),
+    )
+
+    def body(carry, p):
+        acc_v, acc_i = carry
+        list_id = probes[:, p]  # [nq]
+        cod = codes[list_id]  # [nq, max_list, bpr]
+        ids_p = list_indices[list_id]  # [nq, max_list]
+        c1 = rot_sqnorms[list_id]
+        gg = corrections[list_id]
+        qdc = jnp.take_along_axis(q_dot_c, list_id[:, None], axis=1)  # [nq, 1]
+
+        bits = unpack_codes_bits(cod, 1, D).astype(jnp.float32)  # [nq, max_list, D]
+        bq = jnp.einsum(
+            "nd,nmd->nm", q_rot, bits,
+            preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST,
+        )
+        mscore = coef * qdc + gg * (bq - 0.5 * sq[:, None]) - c1
+        if metric == DistanceType.InnerProduct:
+            dist = mscore
+        else:
+            dist = jnp.maximum(qn[:, None] - mscore, 0.0)
+
+        valid = ids_p >= 0
+        if has_filter:
+            word = filter_bits[jnp.clip(ids_p, 0, None) // 32]
+            bit = (word >> (jnp.clip(ids_p, 0, None) % 32).astype(jnp.uint32)) & 1
+            valid = valid & (bit == 1)
+        dist = jnp.where(valid, dist, worst)
+        ids_masked = jnp.where(valid, ids_p, -1)
+        return running_merge(acc_v, acc_i, dist, ids_masked, select_min=select_min), None
+
+    (vals, idx), _ = lax.scan(body, init, jnp.arange(n_probes))
+
+    if metric == DistanceType.L2SqrtExpanded:
+        vals = jnp.where(idx >= 0, jnp.sqrt(jnp.maximum(vals, 0.0)), vals)
+    return vals, idx
+
+
 def scan_chunk_lists(n_lists: int, max_list: int) -> int:
     """Chunk size for the decode scan: ~256k rows (decode temporaries are
     [rows, pq_dim, ksub]-shaped, so PQ chunks stay smaller than the flat
@@ -1203,6 +1633,11 @@ def _search_dispatch(
     n_probes = min(params.n_probes, index.n_lists)
     nq = queries.shape[0]
     filter_bits = prefilter.bits if prefilter is not None else None
+
+    if index.rabitq:
+        return _rabitq_modes(
+            index, queries, k, params, filter_bits, n_probes, query_batch, mode
+        )
 
     # every per_subspace width is fused-eligible: ksub <= 64 decodes in one
     # multi-hot pass, 128/256 (the reference's DEFAULT pq_bits=8 config)
@@ -1427,12 +1862,191 @@ def _search_dispatch(
     return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
 
 
+def _rabitq_modes(
+    index: IvfPqIndex,
+    queries,
+    k: int,
+    params: IvfPqSearchParams,
+    filter_bits,
+    n_probes: int,
+    query_batch: int,
+    mode: str,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mode routing for ``pq_kind="rabitq"`` — same fused/scan/probe
+    trio as the PQ dispatch, backed by the rabitq estimator paths (the
+    refine/prefilter/batching plumbing upstream is shared verbatim)."""
+    from raft_tpu.ops.pallas.rabitq_scan import (
+        ivf_rabitq_fused_search,
+        rabitq_feasible,
+        vmem_decode_rows,
+    )
+
+    nq = queries.shape[0]
+    fused_ok = index.metric in _SUPPORTED and rabitq_feasible(
+        m=index.max_list,
+        bpr=index.codes.shape[2],
+        qt=params.fused_qt,
+        k=k,
+        g_lists=params.fused_group,
+        rot_dim=index.rot_dim,
+        merge=params.fused_merge,
+    )
+    requested_mode = mode
+    if mode == "auto":
+        if nq >= 128 and jax.default_backend() == "tpu" and fused_ok:
+            mode = "fused"
+        else:
+            mode = "scan" if nq >= 128 else "probe"
+    expects(
+        mode in ("scan", "probe", "fused"), "mode must be auto|scan|probe|fused, got %r", mode
+    )
+    if obs.is_enabled():
+        obs.inc("ivf_pq.search.calls", mode=mode, lut="rabitq")
+        obs.inc("ivf_pq.search.queries", float(nq))
+        obs.inc("ivf_pq.search.rabitq.queries", float(nq))
+        obs.observe("ivf_pq.search.n_probes", float(n_probes))
+
+    if mode == "fused":
+        expects(
+            fused_ok,
+            "fused rabitq mode needs a supported metric and a VMEM-feasible "
+            "list length (use mode='scan' or more n_lists)",
+        )
+        rank = index.center_rank
+        group = params.fused_group
+        if rank is None:
+            from raft_tpu.neighbors.ivf_flat import _legacy_rank_cache
+
+            rank = _legacy_rank_cache(index.centers)
+            group = 1
+        group = max(1, min(group, index.n_lists))
+        while index.n_lists % group:
+            group -= 1
+
+        def run_fused(qc):
+            return ivf_rabitq_fused_search(
+                index.centers,
+                index.centers_rot,
+                rank,
+                index.rotation,
+                index.codes,
+                index.list_indices,
+                index.rot_sqnorms,
+                index.corrections,
+                qc,
+                filter_bits,
+                k=k,
+                n_probes=n_probes,
+                metric=index.metric,
+                qt=params.fused_qt,
+                probe_factor=params.fused_probe_factor,
+                group=group,
+                has_filter=filter_bits is not None,
+                merge=params.fused_merge,
+                extract_every=params.fused_extract_every,
+                # VMEM-model cap on rows decoded per pass (the rabitq
+                # analog of pq_scan's decode_cols chunking).
+                decode_rows=vmem_decode_rows(
+                    m=index.max_list,
+                    bpr=index.codes.shape[2],
+                    qt=params.fused_qt,
+                    k=k,
+                    g_lists=group,
+                    rot_dim=index.rot_dim,
+                    merge=params.fused_merge,
+                ),
+                interpret=jax.default_backend() != "tpu",
+            )
+
+        from raft_tpu.neighbors.ivf_flat import _batched_search
+
+        try:
+            # same host-level fault seam as the PQ fused path: the robust
+            # layer's chaos hooks cover both kernels with one point
+            _faults.fire("pallas.pq_scan", nq=int(nq))
+            with obs.span("ivf_pq.search.rabitq_scan", nq=nq, k=k, n_probes=n_probes) as sp:
+                return sp.sync(_batched_search(run_fused, queries, query_batch))
+        except _fallback.FALLBACK_ERRORS as e:
+            if requested_mode == "fused":
+                raise  # the caller pinned the engine; do not mask
+            _fallback.record_fallback("ivf_pq", e)
+            mode = "scan"
+
+    if mode == "scan":
+        g = scan_chunk_lists(index.n_lists, index.max_list)
+        out_v, out_i = [], []
+        for start in range(0, nq, query_batch):
+            qc = queries[start : start + query_batch]
+            bpad = 0
+            if qc.shape[0] < query_batch and nq > query_batch:
+                bpad = query_batch - qc.shape[0]
+                qc = jnp.pad(qc, ((0, bpad), (0, 0)))
+            v, i = _ivf_rabitq_scan_impl(
+                index.centers,
+                index.rotation,
+                index.codes,
+                index.corrections,
+                index.list_indices,
+                index.rot_sqnorms,
+                qc.astype(jnp.float32),
+                filter_bits,
+                k=k,
+                n_probes=n_probes,
+                metric=index.metric,
+                has_filter=filter_bits is not None,
+                chunk_lists=g,
+            )
+            if bpad:
+                v, i = v[:-bpad], i[:-bpad]
+            out_v.append(v)
+            out_i.append(i)
+        if len(out_v) == 1:
+            return out_v[0], out_i[0]
+        return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
+
+    # probe mode: the unpacked-bit temporary is [qb, max_list, D] f32 — cap
+    # the batch the same way the PQ probe path caps its LUT gather.
+    per_q = max(1, index.rot_dim * index.max_list * 4)
+    query_batch = max(1, min(query_batch, (512 << 20) // per_q))
+    out_v, out_i = [], []
+    for start in range(0, nq, query_batch):
+        qc = queries[start : start + query_batch]
+        bpad = 0
+        if qc.shape[0] < query_batch and nq > query_batch:
+            bpad = query_batch - qc.shape[0]
+            qc = jnp.pad(qc, ((0, bpad), (0, 0)))
+        with obs.span("ivf_pq.search.probe_scan", nq=qc.shape[0], k=k) as sp:
+            v, i = sp.sync(
+                _ivf_rabitq_probe_impl(
+                    index.centers,
+                    index.rotation,
+                    index.codes,
+                    index.corrections,
+                    index.list_indices,
+                    index.rot_sqnorms,
+                    qc.astype(jnp.float32),
+                    filter_bits,
+                    k=k,
+                    n_probes=n_probes,
+                    metric=index.metric,
+                    has_filter=filter_bits is not None,
+                )
+            )
+        if bpad:
+            v, i = v[:-bpad], i[:-bpad]
+        out_v.append(v)
+        out_i.append(i)
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
+
+
 # ---------------------------------------------------------------------------
 # serialization (neighbors/ivf_pq_serialize.cuh analog)
 # ---------------------------------------------------------------------------
 
 _KIND = "ivf_pq"
-_VERSION = 3
+_VERSION = 4  # v4 adds the rabitq flag + corrections array
 
 
 def _write_body(index: IvfPqIndex, stream: BinaryIO) -> None:
@@ -1444,6 +2058,7 @@ def _write_body(index: IvfPqIndex, stream: BinaryIO) -> None:
     ser.serialize_scalar(stream, int(index.additive), "int32")
     ser.serialize_scalar(stream, int(index.packed), "int32")
     ser.serialize_scalar(stream, int(index.center_rank is not None), "int32")
+    ser.serialize_scalar(stream, int(index.rabitq), "int32")
     ser.serialize_array(stream, index.centers)
     ser.serialize_array(stream, index.centers_rot)
     ser.serialize_array(stream, index.rotation)
@@ -1452,6 +2067,8 @@ def _write_body(index: IvfPqIndex, stream: BinaryIO) -> None:
     ser.serialize_array(stream, index.list_indices)
     ser.serialize_array(stream, index.list_sizes)
     ser.serialize_array(stream, index.rot_sqnorms)
+    if index.rabitq:
+        ser.serialize_array(stream, index.corrections)
     if index.center_rank is not None:
         ser.serialize_array(stream, index.center_rank)
 
@@ -1471,11 +2088,13 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfPqIndex:
     per_cluster = bool(ser.deserialize_scalar(stream, "int32"))
     cap_factor = float(ser.deserialize_scalar(stream, "float64")) if version >= 2 else 0.0
     additive = packed = False
-    has_rank = False
+    has_rank = rabitq = False
     if version >= 3:
         additive = bool(ser.deserialize_scalar(stream, "int32"))
         packed = bool(ser.deserialize_scalar(stream, "int32"))
         has_rank = bool(ser.deserialize_scalar(stream, "int32"))
+    if version >= 4:
+        rabitq = bool(ser.deserialize_scalar(stream, "int32"))
     centers = ser.deserialize_array(stream)
     centers_rot = ser.deserialize_array(stream)
     rotation = ser.deserialize_array(stream)
@@ -1487,6 +2106,7 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfPqIndex:
         rot_sqnorms = ser.deserialize_array(stream)
     else:
         rot_sqnorms = _sqnorms_for(codes, centers_rot, pq_centers, per_cluster)
+    corrections = ser.deserialize_array(stream) if rabitq else None
     center_rank = ser.deserialize_array(stream) if has_rank else None
     return IvfPqIndex(
         centers=centers,
@@ -1505,6 +2125,8 @@ def load(stream: BinaryIO, res: Optional[Resources] = None) -> IvfPqIndex:
         additive=additive,
         packed=packed,
         center_rank=center_rank,
+        rabitq=rabitq,
+        corrections=corrections,
     )
 
 

@@ -221,6 +221,89 @@ def pq_decode_chunk_budget(
     return int(limit * headroom) - fixed
 
 
+#: Peak live bytes per (row, rot_dim-column) cell of one RaBitQ decode
+#: chunk: the f32 byte-spread lanes, the f32 shift temp, and the f32
+#: sign-bit plane live at once (3 x 4 B).
+RABITQ_DECODE_CELL_BYTES = 12
+
+
+def rabitq_scan_residency(
+    *,
+    m: int,
+    bpr: int,
+    qt: int = 128,
+    k: int = 128,
+    g_lists: int = 8,
+    rot_dim: int = 128,
+    merge: str = "bank8",
+    decode_rows: int = 0,
+) -> KernelResidency:
+    """Model ``rabitq_scan.fused_rabitq_topk``'s VMEM residency for one
+    grid step. Same accounting discipline as :func:`pq_scan_residency`
+    (tests assert these shapes against the kernel's literal BlockSpec /
+    scratch declarations); the LUT tile is replaced by the per-slot
+    correction channel, and the scalable body intermediate is a ROW
+    chunk of unpacked sign bits (``[rows, rot_dim]`` f32 planes,
+    :data:`RABITQ_DECODE_CELL_BYTES`/cell) — the bit-dot accumulates
+    into the same full ``[qt, m]`` body buffer pq_scan keeps.
+
+    ``decode_rows=0`` omits the chunk (for computing the fixed
+    residents the row budget is solved against)."""
+    gm = g_lists * m
+    banks = merge_banks(merge, m)
+    residents = [
+        # in tiles, in fused_rabitq_topk's in_specs order
+        Resident("q_rot", (qt, rot_dim), 4),
+        Resident("centers_rot", (1, g_lists, rot_dim), 4, buffers=2),
+        Resident("codes", (1, gm, bpr), 1, buffers=2),
+        Resident("ln", (1, 1, gm), 4, buffers=2),
+        Resident("corr", (1, 1, gm), 4, buffers=2),
+        Resident("out_vals", (qt, k), 4),
+        Resident("out_idx", (qt, k), 4),
+        # scratch_shapes, in declaration order
+        Resident("acc_vals", (qt, k), 4, kind="scratch"),
+        Resident("acc_idx", (qt, k), 4, kind="scratch"),
+        Resident("bank_vals", (qt, banks * 128), 4, kind="scratch"),
+        Resident("bank_idx", (qt, banks * 128), 4, kind="scratch"),
+        # peak non-chunk body intermediates: the bit-dot accumulator, the
+        # per-step coarse q.c tile, and the [bpr, rot_dim] byte-spread
+        Resident("dot_acc", (qt, m), 4, kind="body"),
+        Resident("qdc", (qt, g_lists), 4, kind="body"),
+        Resident("spread", (bpr, rot_dim), 4, kind="body"),
+    ]
+    if decode_rows:
+        residents.append(
+            Resident(
+                "decode_chunk", (decode_rows, rot_dim), RABITQ_DECODE_CELL_BYTES,
+                kind="chunk",
+            )
+        )
+    return KernelResidency("rabitq_scan.fused_rabitq_topk", tuple(residents))
+
+
+def rabitq_decode_rows_budget(
+    *,
+    m: int,
+    bpr: int,
+    qt: int = 128,
+    k: int = 128,
+    g_lists: int = 8,
+    rot_dim: int = 128,
+    merge: str = "bank8",
+    limit: int = VMEM_LIMIT_BYTES,
+    headroom: float = VMEM_HEADROOM,
+) -> int:
+    """Bytes one RaBitQ decode row-chunk may occupy: ``headroom x
+    limit`` minus the kernel's fixed residents at this shape. Per row
+    the chunk costs ``RABITQ_DECODE_CELL_BYTES * rot_dim`` bytes of
+    sign-bit planes; may be <= 0 when the shape is fused-infeasible."""
+    fixed = rabitq_scan_residency(
+        m=m, bpr=bpr, qt=qt, k=k, g_lists=g_lists, rot_dim=rot_dim,
+        merge=merge, decode_rows=0,
+    ).fixed_bytes
+    return int(limit * headroom) - fixed
+
+
 def cagra_search_residency(
     *,
     itopk: int = 160,

@@ -101,6 +101,72 @@ def test_kmeans_cosine(blobs):
     assert float(out.inertia) >= 0
 
 
+# -- flash (Flash-KMeans exact blocked/bounded E step) -----------------------
+
+
+class TestFlashKMeans:
+    """``algorithm="flash"`` swaps the Lloyd E step for the cached,
+    blocked, norm-bounded assignment — EXACT, not approximate, so it
+    must agree with the dense path sample-for-sample."""
+
+    METRICS = ["l2", "l2sqrt", "ip", "cosine"]
+
+    def _metric(self, name):
+        from raft_tpu.ops.distance import DistanceType
+
+        return {
+            "l2": DistanceType.L2Expanded,
+            "l2sqrt": DistanceType.L2SqrtExpanded,
+            "ip": DistanceType.InnerProduct,
+            "cosine": DistanceType.CosineExpanded,
+        }[name]
+
+    @pytest.mark.parametrize("metric", METRICS)
+    def test_flash_assignment_matches_dense(self, blobs, metric):
+        from raft_tpu.cluster.kmeans import flash_min_cluster_and_distance
+        from raft_tpu.ops.fused_1nn import min_cluster_and_distance
+
+        X, _, centers = blobs
+        m = self._metric(metric)
+        X = X + 5.0 if metric == "cosine" else X  # keep off the origin
+        ld, vd = min_cluster_and_distance(X, centers, metric=m)
+        lf, vf = flash_min_cluster_and_distance(X, centers, metric=m)
+        np.testing.assert_array_equal(np.asarray(ld), np.asarray(lf))
+        np.testing.assert_allclose(np.asarray(vd), np.asarray(vf), rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("metric", ["l2", "ip"])
+    def test_flash_fit_matches_lloyd(self, blobs, metric):
+        """Same seed, same init: flash and lloyd walk the same EM
+        trajectory (the E step is exact) — same labels, same objective,
+        same iteration count."""
+        X, _, _ = blobs
+        m = self._metric(metric)
+        base = dict(n_clusters=6, seed=0, max_iter=40, metric=m)
+        lloyd = kmeans.fit(X, KMeansParams(algorithm="lloyd", **base))
+        flash = kmeans.fit(X, KMeansParams(algorithm="flash", **base))
+        np.testing.assert_array_equal(np.asarray(lloyd.labels), np.asarray(flash.labels))
+        np.testing.assert_allclose(
+            np.asarray(lloyd.centroids), np.asarray(flash.centroids), rtol=1e-5, atol=1e-5
+        )
+        assert abs(float(lloyd.inertia) - float(flash.inertia)) <= 1e-3 * max(
+            1.0, abs(float(lloyd.inertia))
+        )
+        assert int(lloyd.n_iter) == int(flash.n_iter)
+
+    def test_flash_objective_vs_reference(self, blobs):
+        X, _, _ = blobs
+        out = kmeans.fit(X, KMeansParams(n_clusters=6, seed=0, algorithm="flash"))
+        ref = numpy_lloyd(X, 6)
+        assert float(out.inertia) <= ref * 1.01, (float(out.inertia), ref)
+
+    def test_unknown_algorithm_rejected(self, blobs):
+        from raft_tpu.core.errors import LogicError
+
+        X, _, _ = blobs
+        with pytest.raises(LogicError):
+            kmeans.fit(X, KMeansParams(n_clusters=4, algorithm="warp"))
+
+
 # -- balanced ---------------------------------------------------------------
 
 

@@ -9,6 +9,7 @@ at the seam, reopen the directory cold, and require the recovered
 search state to equal either the pre-mutation or the post-mutation
 state — bit-for-bit, never a mix.
 """
+import dataclasses
 import os
 
 import numpy as np
@@ -16,7 +17,13 @@ import pytest
 
 from raft_tpu.core.errors import CorruptIndexError, LogicError
 from raft_tpu.core import serialize as ser
-from raft_tpu.mutable import MutableIndex, WalRecord, WriteAheadLog, replay
+from raft_tpu.mutable import (
+    MutableIndex,
+    WalRecord,
+    WriteAheadLog,
+    replay,
+    segment_paths,
+)
 from raft_tpu.mutable import manifest as man
 from raft_tpu.robust import faults
 
@@ -96,6 +103,115 @@ class TestWal:
     def test_missing_file_is_empty_log(self, tmp_path):
         records, good = replay(str(tmp_path / "absent.log"))
         assert records == [] and good == 0
+
+
+# -- WAL segment rotation ----------------------------------------------------
+
+
+class TestWalRotation:
+    #: one insert frame at DIM=16 is ~374 bytes; 1200 holds three
+    MAX_BYTES = 1200
+
+    def _fill(self, rng, path, n=20):
+        wal, recovered = WriteAheadLog.open(path, max_bytes=self.MAX_BYTES)
+        assert recovered == []
+        for i in range(n):
+            wal.append(WalRecord(op="insert", ids=np.array([i], np.int64),
+                                 vectors=_rows(rng, 1)))
+        return wal
+
+    def test_rotation_bounds_segments_and_replays_in_order(self, rng, tmp_path):
+        path = str(tmp_path / "wal.log")
+        wal = self._fill(rng, path)
+        segs = wal.segment_paths()
+        assert len(segs) > 1 and wal.segment == len(segs) - 1
+        # sealed segments respect the cap and end on whole frames
+        for sp in segs[:-1]:
+            assert os.path.getsize(sp) <= self.MAX_BYTES
+            _, good = replay(sp)
+            assert good == os.path.getsize(sp)
+        wal.close()
+        # reopen replays every segment in sequence order
+        wal2, recs = WriteAheadLog.open(path, max_bytes=self.MAX_BYTES)
+        assert [int(r.ids[0]) for r in recs] == list(range(20))
+        assert wal2.segment == len(segs) - 1  # appends continue in the tail
+        wal2.append(WalRecord(op="delete", ids=np.array([0], np.int64)))
+        wal2.close()
+        _, recs3 = WriteAheadLog.open(path)
+        assert [r.op for r in recs3] == ["insert"] * 20 + ["delete"]
+
+    def test_oversized_frame_lands_whole(self, rng, tmp_path):
+        """A single frame larger than max_bytes is never split — it
+        lands whole in its own segment (frames are the atomicity unit)."""
+        path = str(tmp_path / "wal.log")
+        wal, _ = WriteAheadLog.open(path, max_bytes=256)
+        big = _rows(rng, 64)  # frame ~16 KiB >> 256
+        wal.append(WalRecord(op="insert", ids=np.arange(64, dtype=np.int64),
+                             vectors=big))
+        wal.append(WalRecord(op="delete", ids=np.array([1], np.int64)))
+        wal.close()
+        _, recs = WriteAheadLog.open(path, max_bytes=256)
+        assert [r.op for r in recs] == ["insert", "delete"]
+        np.testing.assert_array_equal(recs[0].vectors, big)
+
+    def test_torn_tail_in_active_segment_recovers_prefix(self, rng, tmp_path):
+        path = str(tmp_path / "wal.log")
+        wal = self._fill(rng, path)
+        active = wal.segment_paths()[-1]
+        wal.close()
+        with open(active, "rb") as f:
+            data = f.read()
+        with open(active, "wb") as f:  # graft-lint: ignore[non-atomic-write] — test fixture damage
+            f.write(data[:-3])  # tear the final frame
+        wal2, recs = WriteAheadLog.open(path, max_bytes=self.MAX_BYTES)
+        assert [int(r.ids[0]) for r in recs] == list(range(19))
+        # the tail was truncated; appending continues cleanly
+        wal2.append(WalRecord(op="insert", ids=np.array([19], np.int64),
+                              vectors=_rows(rng, 1)))
+        wal2.close()
+        _, recs3 = WriteAheadLog.open(path)
+        assert [int(r.ids[0]) for r in recs3] == list(range(20))
+
+    def test_torn_sealed_segment_orphans_later_segments(self, rng, tmp_path):
+        """A tear in a *sealed* segment stops recovery at the tear; the
+        later segments (written after it) are outside the valid prefix
+        and get unlinked so the invariant is restored."""
+        path = str(tmp_path / "wal.log")
+        wal = self._fill(rng, path)
+        segs = wal.segment_paths()
+        wal.close()
+        sealed = segs[2]
+        with open(sealed, "rb") as f:
+            data = f.read()
+        with open(sealed, "wb") as f:  # graft-lint: ignore[non-atomic-write] — test fixture damage
+            f.write(data[:-5])
+        wal2, recs = WriteAheadLog.open(path, max_bytes=self.MAX_BYTES)
+        # segments 0..1 are whole (3 frames each), segment 2 lost its last
+        assert [int(r.ids[0]) for r in recs] == list(range(8))
+        assert wal2.segment == 2  # the torn segment became the active one
+        for orphan in segs[3:]:
+            assert not os.path.exists(orphan)
+        wal2.close()
+
+    def test_mutable_index_rotates_and_compaction_cleans_segments(self, rng, tmp_path):
+        d = str(tmp_path / "idx")
+        mut = MutableIndex.open(d, "brute_force", DIM, max_wal_bytes=self.MAX_BYTES)
+        data = _rows(rng, 24)
+        for row in data:
+            mut.insert(row[None])
+        assert mut.wal.segment > 0
+        mut.close()
+        # cold reopen replays across the rotated segments
+        mut2 = MutableIndex.open(d, "brute_force", DIM, max_wal_bytes=self.MAX_BYTES)
+        assert mut2.size == 24
+        old_segs = segment_paths(mut2.wal.path)
+        assert len(old_segs) > 1
+        mut2.compact()
+        for sp in old_segs:  # superseded generation leaves no segments behind
+            assert not os.path.exists(sp)
+        _, i = mut2.search(data[:2], 1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0], [0, 1])
+        mut2.close()
 
 
 # -- basic mutability semantics ---------------------------------------------
@@ -196,10 +312,13 @@ def _same(a, b):
 class TestCrashChaos:
     """Kill at each seam; recovery must be pre- xor post-mutation."""
 
-    @pytest.fixture
-    def seeded(self, rng, tmp_path):
+    # the rotated variant sets max_wal_bytes low enough that every
+    # post-seed append triggers a segment rotation, so each seam kill
+    # also exercises the rotation path (sealed prefix + fresh segment)
+    @pytest.fixture(params=[None, 600], ids=["wal-single", "wal-rotated"])
+    def seeded(self, rng, tmp_path, request):
         d = str(tmp_path / "idx")
-        mut = MutableIndex.open(d, "brute_force", DIM)
+        mut = MutableIndex.open(d, "brute_force", DIM, max_wal_bytes=request.param)
         self.data = _rows(rng, 64)
         self.ids = mut.insert(self.data)
         mut.compact()  # main segment populated, empty delta
@@ -360,6 +479,87 @@ class TestFreshness:
         d_ref, i_ref = fresh.search(queries, k)
         np.testing.assert_array_equal(i_mut, i_ref)
         np.testing.assert_array_equal(d_mut, d_ref)
+
+
+# -- delta-segment fused fast path ------------------------------------------
+
+
+class TestDeltaFusedScan:
+    """The fused single-list kernel route must be candidate-exact
+    against the plain-XLA brute-force delta scan inside its
+    eligibility window (padded delta <= 1024 rows, L2/IP metrics)."""
+
+    def _churned(self, rng, metric):
+        mut = MutableIndex("brute_force", DIM, metric=metric)
+        base = _rows(rng, 200)
+        bids = mut.insert(base)
+        mut.compact()  # main segment, then grow a delta with tombstones
+        extra = mut.insert(_rows(rng, 90))
+        mut.delete(np.concatenate([bids[:10], extra[:7]]))
+        return mut
+
+    @pytest.mark.parametrize("metric", ["l2", "l2sqrt", "ip"])
+    def test_fused_matches_exact_bitwise(self, rng, metric):
+        from raft_tpu.ops.distance import DistanceType
+
+        m = {
+            "l2": DistanceType.L2Expanded,
+            "l2sqrt": DistanceType.L2SqrtExpanded,
+            "ip": DistanceType.InnerProduct,
+        }[metric]
+        mut = self._churned(rng, m)
+        queries = _rows(rng, 33)  # odd count exercises the qt padding
+        snap = mut.snapshot()
+        d_ex, i_ex = dataclasses.replace(snap, delta_mode="exact").search(queries, 10)
+        d_fu, i_fu = dataclasses.replace(snap, delta_mode="fused").search(queries, 10)
+        np.testing.assert_array_equal(i_ex, i_fu)
+        np.testing.assert_allclose(d_ex, d_fu, rtol=1e-6, atol=1e-6)
+
+    def test_index_level_knob(self, rng):
+        mut = MutableIndex("brute_force", DIM, delta_mode="fused")
+        ids = mut.insert(_rows(rng, 50))
+        mut.delete(ids[:5])
+        queries = _rows(rng, 4)
+        d, i = mut.search(queries, 8)
+        # rebuild the same state on the exact route
+        live_ids, live_vecs = mut.live_rows()
+        ref2 = MutableIndex("brute_force", DIM, delta_mode="exact")
+        ref2.insert(live_vecs, ids=live_ids)
+        d2, i2 = ref2.search(queries, 8)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d), np.asarray(d2), rtol=1e-6, atol=1e-6)
+
+    def test_routing_and_eligibility(self):
+        from raft_tpu.mutable.segments import _delta_route
+        from raft_tpu.ops.distance import DistanceType
+
+        l2 = DistanceType.L2Expanded
+        assert _delta_route("exact", l2, 256, 10) == "exact"
+        assert _delta_route("fused", l2, 1024, 10) == "fused"
+        # over the lossless bank window, auto falls back to exact
+        assert _delta_route("auto", l2, 2048, 10) == "exact"
+        with pytest.raises(LogicError):
+            _delta_route("fused", l2, 2048, 10)  # forced but ineligible
+        with pytest.raises(LogicError):
+            _delta_route("fused", l2, 256, 300)  # k past one extract width
+        with pytest.raises(LogicError):
+            _delta_route("bogus", l2, 256, 10)
+        with pytest.raises(LogicError):
+            MutableIndex("brute_force", DIM, delta_mode="bogus")
+
+    def test_fused_respects_tombstones_and_padding(self, rng):
+        """Dead and padding rows must never surface: delete everything
+        but 3 delta rows, ask for more than survive."""
+        from raft_tpu.ops.distance import DistanceType
+
+        mut = MutableIndex("brute_force", DIM, metric=DistanceType.L2Expanded)
+        ids = mut.insert(_rows(rng, 40))
+        mut.delete(ids[3:])
+        snap = dataclasses.replace(mut.snapshot(), delta_mode="fused")
+        d, i = snap.search(_rows(rng, 2), 8)
+        assert set(np.asarray(i)[:, :3].ravel()) <= {0, 1, 2}
+        assert (np.asarray(i)[:, 3:] == -1).all()
+        assert np.isinf(np.asarray(d)[:, 3:]).all()
 
 
 # -- snapshot-consistent serving + bounded recompiles -----------------------

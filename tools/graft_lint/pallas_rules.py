@@ -54,6 +54,12 @@ KERNEL_SHAPE_BINDINGS: Dict[str, Dict[str, object]] = {
         qt=128, k=10, K=8192, rot_dim=128, g_lists=8, m=1152, gm=9216,
         bpr=32, banks=8,
     ),
+    # the fused RaBitQ sign-bit kernel at the same 1M-row bench shape
+    # (bpr = rot_dim/8 = 16 B/row of packed sign codes)
+    "rabitq_scan": dict(
+        qt=128, k=10, rot_dim=128, g_lists=8, m=1152, gm=9216, bpr=16,
+        banks=8,
+    ),
     "ivf_scan": dict(qt=128, k=10, d=128, m=1152, w=1024),
     # the fused CAGRA beam kernel at the 1M-row bench shape
     # (vmem_model.cagra_search_residency defaults)

@@ -75,6 +75,18 @@ def parse_file(path: str) -> Dict[str, Any]:
     return out
 
 
+#: counter-name prefixes that describe which search kernel/mode a query
+#: actually took (includes the rabitq/pq lut labels on ivf_pq.search.*
+#: and the mutable delta segment's fused-vs-exact routing)
+_DISPATCH_PREFIXES = (
+    "ivf_pq.search.",
+    "ivf_flat.search.",
+    "brute_force.search.",
+    "cagra.search.",
+    "mutable.delta.",
+)
+
+
 def _key(rec: Dict[str, Any]) -> str:
     labels = rec.get("labels") or {}
     if not labels:
@@ -159,21 +171,37 @@ def render_report(*paths: str, top: int = 10) -> str:
         ]
         sections.append(f"## top {len(rows)} spans by self-time\n"
                         + _table(rows, ["span", "count", "self_ms", "total_ms", "mean_ms"]))
+    # search-path routing gets its own table: the per-mode dispatch
+    # counters (fused / scan / probe, lut="rabitq" vs nibble/f32, the
+    # delta segment's fused-vs-exact route) answer the first question a
+    # perf investigation asks — "which kernel actually ran?" — including
+    # silent fused→XLA fallbacks that only show up as a mode shift here
+    dispatch_rows = [
+        [k, f"{v:g}"]
+        for k, v in sorted(counters.items())
+        if k.startswith(_DISPATCH_PREFIXES)
+    ]
+    if dispatch_rows:
+        sections.append("## search dispatch\n"
+                        + _table(dispatch_rows, ["counter", "value"]))
     # robustness + mutability get their own table: fault fires, retries,
-    # fallbacks, WAL traffic, tombstone fraction, generations — the
-    # health picture an operator scans first, pulled out of the generic
-    # tables so it cannot drown in per-algo serving counters
+    # fallbacks, WAL traffic (records/bytes/rotations), tombstone
+    # fraction, generations — the health picture an operator scans
+    # first, pulled out of the generic tables so it cannot drown in
+    # per-algo serving counters
     health_rows = [
         [k, kind, f"{v:g}"]
         for kind, table in (("counter", counters), ("gauge", gauges))
         for k, v in sorted(table.items())
         if k.startswith(("robust.", "mutable.", "faults."))
+        and not k.startswith(_DISPATCH_PREFIXES)
     ]
     if health_rows:
         sections.append("## robustness & mutability\n"
                         + _table(health_rows, ["metric", "kind", "value"]))
     plain = {k: v for k, v in counters.items()
-             if not k.startswith(("robust.", "mutable.", "faults."))}
+             if not k.startswith(("robust.", "mutable.", "faults.")
+                                 + _DISPATCH_PREFIXES)}
     if plain:
         rows = [[k, f"{v:g}"] for k, v in sorted(plain.items())]
         sections.append("## counters\n" + _table(rows, ["counter", "value"]))
