@@ -274,7 +274,11 @@ _NON_PARETO = ("cagra_latency", "mutable_churn")
 
 
 def _is_pareto_algo(algo):
-    return algo not in _NON_PARETO and not algo.startswith("serve_")
+    return (
+        algo not in _NON_PARETO
+        and not algo.startswith("serve_")
+        and not algo.startswith("sharded_")
+    )
 
 
 def pareto_summary(results, floors=(0.90, 0.95, 0.99)):
@@ -431,6 +435,13 @@ def _run_cpu_smoke_subprocess():
     env["RAFT_TPU_BENCH_SMOKE"] = "1"
     env.setdefault("RAFT_TPU_BENCH_HARD_TIMEOUT_S", "1500")
     env.setdefault("RAFT_TPU_BENCH_BUDGET_S", "1200")
+    # 8 virtual devices so the smoke run also exercises the multichip
+    # ring-vs-gather phase (single-chip phases still run on device 0)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     code = (
         "import jax; jax.config.update('jax_platforms', 'cpu'); "
         "import bench; bench._bench_main()"
@@ -1180,6 +1191,77 @@ def _bench_main():
             print(f"# mutable_churn failed: {phase_errors['mutable_churn']}",
                   flush=True)
 
+    # ---- multichip: ring vs gather candidate exchange --------------------
+    # same query stream through both merge transports (sharded_*_ring /
+    # sharded_*_gather rows) plus the per-query ICI wire-byte model — the
+    # measurement behind the ring exchange's >=2x wire reduction claim.
+    # Transport comparisons, not Pareto points (sharded_* is excluded).
+    ring_speedup = {}
+    n_dev = jax.device_count()
+    if over_budget(0.96):
+        print("# multichip skipped: time budget", flush=True)
+    elif n_dev < 2:
+        print(f"# multichip skipped: {n_dev} device(s)", flush=True)
+    else:
+        try:
+            from raft_tpu.ops.pallas.ring_topk import wire_bytes_per_query
+            from raft_tpu.parallel.comms import make_mesh
+            from raft_tpu.parallel.sharded_ann import sharded_ivf_flat_search
+            from raft_tpu.parallel.sharded_knn import sharded_knn
+
+            mesh = make_mesh(jax.devices())
+            mrows = (n_rows // n_dev) * n_dev
+            mset = dataset[:mrows]
+            wire = {m: wire_bytes_per_query(n_dev, K, m) for m in ("ring", "gather")}
+            targets = [(
+                "sharded_knn",
+                lambda m: sharded_knn(
+                    mesh, mset, queries, K,
+                    metric=DistanceType.L2Expanded, merge_mode=m,
+                ),
+            )]
+            live = locals()
+            if live.get("fidx") is not None:
+                sp_mc = ivf_flat.IvfFlatSearchParams(n_probes=30)
+                targets.append((
+                    "sharded_ivf_flat",
+                    lambda m: sharded_ivf_flat_search(
+                        mesh, fidx, queries, K, sp_mc, merge_mode=m
+                    ),
+                ))
+            for name, run in targets:
+                per_mode = {}
+                for m in ("ring", "gather"):
+                    dt, (v, i) = _timed(
+                        lambda run=run, m=m: run(m), label=f"{name}_{m}"
+                    )
+                    record(f"{name}_{m}", f"nd={n_dev} k={K}", dt, i,
+                           wire_bytes_per_query=round(wire[m], 1))
+                    per_mode[m] = (dt, np.asarray(i))
+                # transport acceptance: identical ids, not just recall
+                np.testing.assert_array_equal(
+                    per_mode["ring"][1], per_mode["gather"][1],
+                    err_msg=f"{name}: ring ids != gather ids",
+                )
+                ring_speedup[name] = {
+                    "qps_ratio": round(
+                        float(per_mode["gather"][0]) / max(float(per_mode["ring"][0]), 1e-12), 3
+                    ),
+                    "wire_reduction": round(wire["gather"] / wire["ring"], 2),
+                    "wire_bytes_per_query": {
+                        m: round(wire[m], 1) for m in ("ring", "gather")
+                    },
+                }
+                print(
+                    f"# ring_speedup     {name}: qps x{ring_speedup[name]['qps_ratio']}"
+                    f"  wire {wire['ring']:.0f} vs {wire['gather']:.0f} B/query"
+                    f" ({ring_speedup[name]['wire_reduction']}x less), ids identical",
+                    flush=True,
+                )
+        except Exception as e:  # noqa: BLE001
+            phase_errors["multichip"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"# multichip failed: {phase_errors['multichip']}", flush=True)
+
     # operating points: best QPS at recall >= MIN_RECALL per algorithm
     # (latency/serving/churn rows carry their own metrics, not Pareto rows)
     ops = {}
@@ -1210,7 +1292,8 @@ def _bench_main():
         try:
             _rec.set_context(build_seconds=build_times, efficiency=efficiency,
                              phase_errors=phase_errors, pareto=pareto,
-                             kmeans_compare=kmeans_compare)
+                             kmeans_compare=kmeans_compare,
+                             ring_speedup=ring_speedup)
         except Exception as e:  # noqa: BLE001
             print(f"# artifact context dropped: {e}", flush=True)
 
@@ -1283,6 +1366,7 @@ def _bench_main():
                     },
                     "pareto": pareto,
                     "kmeans_compare": kmeans_compare,
+                    "ring_speedup": ring_speedup,
                     "all_results": results,
                     "build_seconds": build_times,
                     "cagra_error": cagra_err,

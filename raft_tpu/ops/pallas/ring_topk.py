@@ -1,0 +1,486 @@
+"""ICI ring top-k merge for sharded search — the communication-avoiding
+replacement for the ``all_gather`` + k-way merge candidate exchange.
+
+The gather path (``parallel/sharded_ann.py`` / ``sharded_knn.py``) ships
+every shard's ``[nq, k]`` candidates to every chip and re-selects over the
+``n_shards x k`` concatenation: per-rank wire traffic is
+``8k(n-1)`` bytes/query and every chip materializes the full candidate
+matrix in HBM. This module runs the same merge as a **ring
+reduce-scatter + ring all-gather over query blocks** — the
+communication-optimal schedule for an associative reduction:
+
+* queries are split into ``n`` blocks; at reduce-scatter hop ``s`` chip
+  ``r`` sends its running partial of block ``(r - s) mod n`` to its right
+  neighbor and folds the block arriving from the left into its own
+  partial — after ``n - 1`` hops chip ``r`` owns the *finished* top-k of
+  block ``(r + 1) mod n``;
+* an all-gather ring then replicates the finished blocks (values + ids
+  only; the merge tie-break lane is no longer needed).
+
+Per-rank wire is ``~20k(n-1)/n`` bytes/query (12 B/candidate while the
+tie-break lane rides along, 8 B after) versus the gather path's
+``8k(n-1)``: a ``0.4 n`` reduction — 3.2x at 8 chips — and peak memory
+stays ``O(k)`` per query instead of ``O(n k)``.
+
+**Bit-parity contract.** The gather path's merge is a stable
+``lax.top_k`` over the shard-major concatenation, i.e. a sort by
+``(value, concat position)``. Each candidate here carries its concat
+position explicitly — ``pos = rank * k + slot`` (unique, total order) —
+and every 2k -> k fold merges by ``(value, pos)``. A merge under a total
+order is associative and schedule-independent, so the ring reproduces
+the gather ids **bit-exactly** at every device count, hop order, and
+degraded-health mask (demoted shards' candidates carry worst-value
+sentinels and their true ``pos``, losing every fold exactly as they lose
+the gather merge — a dead shard degenerates to a pass-through that
+forwards its neighbor's buffer unchanged). Values are carried, never
+recomputed, so distances are bit-identical too.
+
+Two engines share that schedule:
+
+* :func:`_ring_topk_xla` — ``lax.ppermute`` hops + a 2-key
+  ``lax.sort`` fold. Runs everywhere (this is the engine the 8-device
+  CPU test mesh exercises for parity) and is what ``merge_mode="ring"``
+  means off-TPU.
+* :func:`fused_ring_topk` — a Pallas kernel holding the per-block
+  partials in VMEM and driving each hop with
+  ``pltpu.make_async_remote_copy`` into the right neighbor's scratch,
+  double-buffered send/recv slots with deferred send-semaphore waits so
+  hop ``s``'s outgoing DMA drains while the hop-``s`` fold runs on the
+  VPU. TPU-only: jax 0.4.x cannot interpret remote DMAs on CPU, so the
+  dispatch gates on the real backend and the fold kernel is covered by
+  an interpret-mode parity test instead
+  (:func:`hop_merge` — the same rank-based placement proven bit-exact
+  in ``cagra_search._rank_merge``, extended with the ``pos`` tie lane).
+
+Failure semantics: :func:`ring_topk` fires the ``comms.ring_topk``
+fault point at trace time (the collective analog of a lost ring
+participant — same placement as the ``comms.all_gather`` seam); callers
+in ``parallel/`` catch :class:`~raft_tpu.core.errors.KernelFailure` /
+runtime errors through ``_guard.kernel_guard`` and fall back to the
+gather merge (warn-once, ``fallbacks{algo="ring_topk"}``). Per-ring obs:
+``comms.ring.hops`` and ``comms.ring.bytes{direction}`` counters and a
+traced ``ring_topk`` span.
+
+VMEM residency of the fused kernel is modeled in
+:func:`raft_tpu.ops.pallas.vmem_model.ring_topk_residency` and checked
+by ``tools/graft_lint`` under the ``ring_topk`` bindings;
+:func:`kernel_scratch_shapes` is asserted against the model in tests.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu import obs
+from raft_tpu.core.errors import expects
+from raft_tpu.parallel._compat import axis_size
+from raft_tpu.robust import faults
+
+#: Finite in-kernel "worst" value (see ``cagra_search.WORST``): the
+#: rank-based fold places elements with masked one-hot sums, and
+#: ``inf * 0`` would poison them with NaNs. The XLA engine keeps true
+#: ``+/-inf`` sentinels (no masked arithmetic there).
+WORST = 3.0e38
+
+#: Sort-key pos for padding entries: must lose every tie against a real
+#: candidate (real pos < n_shards * k << _PAD_POS).
+_PAD_POS = jnp.iinfo(jnp.int32).max
+
+#: Query-row chunk of the in-kernel fold — bounds the pairwise-rank
+#: body intermediates to ~4 MiB at the serving shape (B=128, w=128).
+_FOLD_ROWS = 32
+
+#: Column chunk of the pairwise rank / one-hot placement passes
+#: (``cagra_search._RANK_CHUNK``).
+_RANK_CHUNK = 64
+
+#: Wire cost per candidate: reduce-scatter hops carry (f32 val, i32 id,
+#: i32 pos); all-gather hops carry (val, id) only.
+RS_ENTRY_BYTES = 12
+AG_ENTRY_BYTES = 8
+
+
+def wire_bytes_per_query(n_shards: int, k: int, mode: str = "ring") -> float:
+    """Estimated per-rank ICI bytes received per query for one merge.
+
+    ``mode="gather"``: each rank receives ``n-1`` foreign ``[k]`` blocks
+    of (f32, i32). ``mode="ring"``: ``n-1`` reduce-scatter hops of one
+    ``nq/n``-query block at :data:`RS_ENTRY_BYTES`/candidate plus
+    ``n-1`` all-gather hops at :data:`AG_ENTRY_BYTES`, amortized over
+    all ``nq`` queries."""
+    n = int(n_shards)
+    if n <= 1:
+        return 0.0
+    if mode == "gather":
+        return float((n - 1) * k * AG_ENTRY_BYTES)
+    return float((n - 1) * k * (RS_ENTRY_BYTES + AG_ENTRY_BYTES)) / n
+
+
+# ---------------------------------------------------------------------------
+# shared schedule helpers
+# ---------------------------------------------------------------------------
+
+
+def _prep(v, i, k: int, select_min: bool, axis: str):
+    """Normalize local candidates to the ring's working layout.
+
+    Returns ``(key, pos, v, i, n, B, nq)`` where the first four are
+    ``[n * B, w]`` with ``w = k``: the sort key (``v`` for min-select,
+    ``-v`` for max), the global concat position tie-break, and the
+    carried value/id payloads. Width is padded (losing sentinels) or
+    truncated (a local 2-key top-k — entries past local rank ``k`` can
+    never enter the global top-k) to ``k``; query rows are padded to a
+    multiple of the axis size."""
+    n = axis_size(axis)
+    r = lax.axis_index(axis)
+    nq, kc = v.shape
+    v = v.astype(jnp.float32)
+    i = i.astype(jnp.int32)
+    pos = (r * kc + lax.broadcasted_iota(jnp.int32, (nq, kc), 1)).astype(jnp.int32)
+    key = v if select_min else -v
+    if kc > k:
+        key, pos, v, i = lax.sort((key, pos, v, i), dimension=1, num_keys=2)
+        key, pos, v, i = key[:, :k], pos[:, :k], v[:, :k], i[:, :k]
+    elif kc < k:
+        pad = ((0, 0), (0, k - kc))
+        key = jnp.pad(key, pad, constant_values=jnp.inf)
+        v = jnp.pad(v, pad, constant_values=jnp.inf if select_min else -jnp.inf)
+        pos = jnp.pad(pos, pad, constant_values=_PAD_POS)
+        i = jnp.pad(i, pad, constant_values=-1)
+    B = -(-nq // n)
+    rpad = n * B - nq
+    if rpad:
+        pad = ((0, rpad), (0, 0))
+        key = jnp.pad(key, pad, constant_values=jnp.inf)
+        v = jnp.pad(v, pad, constant_values=jnp.inf if select_min else -jnp.inf)
+        pos = jnp.pad(pos, pad, constant_values=_PAD_POS)
+        i = jnp.pad(i, pad, constant_values=-1)
+    return key, pos, v, i, n, B, nq
+
+
+def _fold(a, b, w: int):
+    """One 2w -> w merge under the (key, pos) total order. ``a``/``b``
+    are (key, pos, val, id) tuples of ``[B, w]`` arrays; pos uniqueness
+    makes the fold associative and schedule-independent — the parity
+    contract with the gather path's stable ``top_k``."""
+    cat = tuple(jnp.concatenate([x, y], axis=1) for x, y in zip(a, b))
+    key, pos, v, i = lax.sort(cat, dimension=1, num_keys=2)
+    return key[:, :w], pos[:, :w], v[:, :w], i[:, :w]
+
+
+# ---------------------------------------------------------------------------
+# XLA engine: ppermute hops (runs everywhere; the CPU-mesh parity engine)
+# ---------------------------------------------------------------------------
+
+
+def _ring_topk_xla(v, i, k: int, select_min: bool, axis: str):
+    key, pos, v, i, n, B, nq = _prep(v, i, k, select_min, axis)
+    r = lax.axis_index(axis)
+    state = tuple(x.reshape(n, B, k) for x in (key, pos, v, i))
+    if n == 1:
+        _, _, ov, oi = tuple(x[0] for x in state)
+        return ov[:nq], oi[:nq]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    take = lambda t, b: tuple(  # noqa: E731
+        lax.dynamic_index_in_dim(x, b, 0, keepdims=False) for x in t
+    )
+    put = lambda t, blk, b: tuple(  # noqa: E731
+        lax.dynamic_update_index_in_dim(x, y, b, 0) for x, y in zip(t, blk)
+    )
+    # -- reduce-scatter: after hop s, my partial of block (r-s-1)%n has
+    # folded in every rank <= me's candidates; after n-1 hops block
+    # (r+1)%n is finished on rank r.
+    for s in range(n - 1):
+        send = take(state, (r - s) % n)
+        recv = tuple(lax.ppermute(x, axis, perm) for x in send)
+        b = (r - s - 1) % n
+        state = put(state, _fold(take(state, b), recv, k), b)
+    # -- all-gather of the finished blocks (key/pos lanes done their job)
+    out_v, out_i = state[2], state[3]
+    for s in range(n - 1):
+        send = take((out_v, out_i), (r + 1 - s) % n)
+        rv, ri = (lax.ppermute(x, axis, perm) for x in send)
+        b = (r - s) % n
+        out_v = lax.dynamic_update_index_in_dim(out_v, rv, b, 0)
+        out_i = lax.dynamic_update_index_in_dim(out_i, ri, b, 0)
+    return out_v.reshape(n * B, k)[:nq], out_i.reshape(n * B, k)[:nq]
+
+
+# ---------------------------------------------------------------------------
+# fused engine: Pallas async-remote-copy ring (real TPU ICI only)
+# ---------------------------------------------------------------------------
+
+
+def kernel_scratch_shapes(n: int, B: int, w: int):
+    """The fused kernel's scratch declarations, exposed so tests can
+    assert them against ``vmem_model.ring_topk_residency`` (the drift
+    guard every fused kernel in this tree carries)."""
+    return [
+        pltpu.VMEM((n, B, w), jnp.float32),   # state_key
+        pltpu.VMEM((n, B, w), jnp.int32),     # state_pos
+        pltpu.VMEM((n, B, w), jnp.float32),   # state_val
+        pltpu.VMEM((n, B, w), jnp.int32),     # state_id
+        pltpu.VMEM((2, B, w), jnp.float32),   # send_key
+        pltpu.VMEM((2, B, w), jnp.int32),     # send_pos
+        pltpu.VMEM((2, B, w), jnp.float32),   # send_val
+        pltpu.VMEM((2, B, w), jnp.int32),     # send_id
+        pltpu.VMEM((2, B, w), jnp.float32),   # recv_key
+        pltpu.VMEM((2, B, w), jnp.int32),     # recv_pos
+        pltpu.VMEM((2, B, w), jnp.float32),   # recv_val
+        pltpu.VMEM((2, B, w), jnp.int32),     # recv_id
+        pltpu.SemaphoreType.DMA((2, 4)),      # send sems [slot, lane]
+        pltpu.SemaphoreType.DMA((2, 4)),      # recv sems [slot, lane]
+    ]
+
+
+def _rank_merge_pos(uk, up, uv, ui, w: int):
+    """Stable (key, pos)-ordered top-``w`` of the union ``[rows, 2w]``
+    via pairwise ranks + one-hot placement — ``cagra_search._rank_merge``
+    with the value tie broken by the unique concat position instead of
+    the local column, which is what makes the fold order-independent.
+    ``rank(i) = #{j : k_j < k_i or (k_j == k_i and p_j < p_i)}`` is a
+    permutation of ``0..2w-1`` (pos unique); ranks ``< w`` survive."""
+    rows, m = uk.shape
+    parts = []
+    for i0 in range(0, m, _RANK_CHUNK):
+        i1 = min(i0 + _RANK_CHUNK, m)
+        ki = uk[:, None, i0:i1]
+        pi = up[:, None, i0:i1]
+        less = (uk[:, :, None] < ki).astype(jnp.int32)
+        tie = ((uk[:, :, None] == ki) & (up[:, :, None] < pi)).astype(jnp.int32)
+        parts.append(jnp.sum(less + tie, axis=1))
+    rank = jnp.concatenate(parts, axis=1)  # [rows, 2w]
+    outs = [[] for _ in range(4)]
+    for p0 in range(0, w, _RANK_CHUNK):
+        p1 = min(p0 + _RANK_CHUNK, w)
+        pidx = lax.broadcasted_iota(jnp.int32, (1, 1, p1 - p0), 2) + p0
+        oh = rank[:, :, None] == pidx  # [rows, 2w, chunk]
+        for o, u in zip(outs, (uk, up, uv, ui)):
+            z = jnp.zeros((), u.dtype)
+            o.append(jnp.sum(jnp.where(oh, u[:, :, None], z), axis=1))
+    return tuple(jnp.concatenate(o, axis=1) for o in outs)
+
+
+def _hop_merge_kernel(ak, ap, av, ai, bk, bp, bv, bi, ok, op, ov, oi):
+    """Single-device fold kernel: merge two [qt, w] candidate tiles into
+    the (key, pos)-ordered top-w. This is the exact fold the ring kernel
+    runs per hop; factored out so interpret-mode tests can pin it
+    against the XLA ``_fold`` bit-for-bit."""
+    w = ak.shape[1]
+    uk = jnp.concatenate([ak[:], bk[:]], axis=1)
+    up = jnp.concatenate([ap[:], bp[:]], axis=1)
+    uv = jnp.concatenate([av[:], bv[:]], axis=1)
+    ui = jnp.concatenate([ai[:], bi[:]], axis=1)
+    rk, rp, rv, ri = _rank_merge_pos(uk, up, uv, ui, w)
+    ok[:], op[:], ov[:], oi[:] = rk, rp, rv, ri
+
+
+@functools.partial(jax.jit, static_argnames=("qt", "interpret"))
+def hop_merge(a, b, qt: int = _FOLD_ROWS, interpret: bool = True):
+    """Run one 2w -> w fold through the Pallas kernel (grid over
+    ``qt``-row tiles). ``a``/``b`` are (key, pos, val, id) tuples of
+    ``[rows, w]`` arrays. Used by tests (interpret mode on CPU) to prove
+    the in-kernel fold bit-matches the XLA fold; the ring kernel inlines
+    the same ``_rank_merge_pos``."""
+    rows, w = a[0].shape
+    expects(rows % qt == 0, "fold rows %d not divisible by tile %d", rows, qt)
+    grid = (rows // qt,)
+    tile = lambda: pl.BlockSpec((qt, w), lambda g: (g, 0))  # noqa: E731
+    dts = (jnp.float32, jnp.int32, jnp.float32, jnp.int32)
+    return pl.pallas_call(
+        _hop_merge_kernel,
+        grid=grid,
+        in_specs=[tile() for _ in range(8)],
+        out_specs=tuple(tile() for _ in range(4)),
+        out_shape=tuple(jax.ShapeDtypeStruct((rows, w), d) for d in dts),
+        interpret=interpret,
+    )(*a, *b)
+
+
+def _ring_kernel(
+    n: int, B: int, w: int, axis: str,
+    ink, inp, inv, ini, ov, oi,
+    sk, sp, sv, si,          # state [n, B, w]
+    tk, tp, tv, ti,          # send slots [2, B, w]
+    rk, rp, rv, ri,          # recv slots [2, B, w]
+    send_sem, recv_sem,
+):
+    """The fused ring: reduce-scatter then all-gather, one
+    ``make_async_remote_copy`` bundle per hop into the right neighbor's
+    recv slot, fold on the VPU while the outgoing DMA drains (its
+    send-semaphore wait is deferred until the slot is restaged two hops
+    later — the double-buffer discipline of the guide's ring
+    all-gather)."""
+    me = lax.axis_index(axis)
+    right = lax.rem(me + 1, n)
+    left = lax.rem(me + n - 1, n)
+
+    # neighbor rendezvous: nobody DMAs into a peer still setting up
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=(left,))
+    pltpu.semaphore_signal(barrier, inc=1, device_id=(right,))
+    pltpu.semaphore_wait(barrier, 2)
+
+    for b in range(n):
+        sk[b], sp[b] = ink[b * B:(b + 1) * B], inp[b * B:(b + 1) * B]
+        sv[b], si[b] = inv[b * B:(b + 1) * B], ini[b * B:(b + 1) * B]
+
+    state = (sk, sp, sv, si)
+    send = (tk, tp, tv, ti)
+    recv = (rk, rp, rv, ri)
+
+    def start_hop(slot, src_block, lanes):
+        """Stage ``state[src_block]`` into the send slot and launch one
+        remote copy per lane into the right neighbor's recv slot."""
+        for ln in lanes:
+            send[ln][slot] = pl.load(
+                state[ln], (pl.ds(src_block, 1), slice(None), slice(None))
+            )[0]
+            pltpu.make_async_remote_copy(
+                src_ref=send[ln].at[slot],
+                dst_ref=recv[ln].at[slot],
+                send_sem=send_sem.at[slot, ln],
+                recv_sem=recv_sem.at[slot, ln],
+                device_id=(right,),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).start()
+
+    lanes_rs = (0, 1, 2, 3)
+    lanes_ag = (2, 3)  # finished blocks travel as (val, id) only
+
+    # -- reduce-scatter hops ------------------------------------------------
+    for s in range(n - 1):
+        slot = s % 2
+        if s >= 2:  # the slot's previous send must have drained
+            for ln in lanes_rs:
+                pltpu.semaphore_wait(send_sem[slot, ln], 1)
+        start_hop(slot, lax.rem(me + n - s, n) if s else me, lanes_rs)
+        for ln in lanes_rs:
+            pltpu.semaphore_wait(recv_sem[slot, ln], 1)
+        dst = lax.rem(me + n - s - 1 + n, n)
+        cur = tuple(pl.load(st, (pl.ds(dst, 1), slice(None), slice(None)))[0] for st in state)
+        got = tuple(rcv[slot] for rcv in recv)
+        for q0 in range(0, B, _FOLD_ROWS):
+            q1 = min(q0 + _FOLD_ROWS, B)
+            uk = jnp.concatenate([cur[0][q0:q1], got[0][q0:q1]], axis=1)
+            up = jnp.concatenate([cur[1][q0:q1], got[1][q0:q1]], axis=1)
+            uv = jnp.concatenate([cur[2][q0:q1], got[2][q0:q1]], axis=1)
+            ui = jnp.concatenate([cur[3][q0:q1], got[3][q0:q1]], axis=1)
+            fk, fp, fv, fi = _rank_merge_pos(uk, up, uv, ui, w)
+            for st, f in zip(state, (fk, fp, fv, fi)):
+                pl.store(st, (pl.ds(dst, 1), pl.ds(q0, q1 - q0), slice(None)), f[None])
+    for s in range(max(0, n - 3), n - 1):  # drain outstanding sends
+        for ln in lanes_rs:
+            pltpu.semaphore_wait(send_sem[s % 2, ln], 1)
+
+    # rank r owns finished block (r+1)%n; write it to the output
+    own = lax.rem(me + 1, n)
+    for dst_ref, ln in ((ov, 2), (oi, 3)):
+        blk = pl.load(state[ln], (pl.ds(own, 1), slice(None), slice(None)))[0]
+        pl.store(dst_ref, (pl.ds(own * B, B), slice(None)), blk)
+
+    # -- all-gather hops: forward the newest finished block rightward -------
+    for s in range(n - 1):
+        slot = s % 2
+        if s >= 2:
+            for ln in lanes_ag:
+                pltpu.semaphore_wait(send_sem[slot, ln], 1)
+        # the block being forwarded is already in the output; stage from
+        # state (hop 0: own block) or from the previous hop's recv slot
+        if s == 0:
+            start_hop(slot, own, lanes_ag)
+        else:
+            for ln in lanes_ag:
+                send[ln][slot] = recv[ln][1 - slot]
+                pltpu.make_async_remote_copy(
+                    src_ref=send[ln].at[slot],
+                    dst_ref=recv[ln].at[slot],
+                    send_sem=send_sem.at[slot, ln],
+                    recv_sem=recv_sem.at[slot, ln],
+                    device_id=(right,),
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                ).start()
+        for ln in lanes_ag:
+            pltpu.semaphore_wait(recv_sem[slot, ln], 1)
+        dst = lax.rem(me + n - s, n)
+        pl.store(ov, (pl.ds(dst * B, B), slice(None)), recv[2][slot])
+        pl.store(oi, (pl.ds(dst * B, B), slice(None)), recv[3][slot])
+    for s in range(max(0, n - 3), n - 1):
+        for ln in lanes_ag:
+            pltpu.semaphore_wait(send_sem[s % 2, ln], 1)
+
+
+def fused_ring_topk(v, i, k: int, select_min: bool, axis: str, collective_id: int = 7):
+    """Pallas async-remote-copy ring (inside ``shard_map``). Same
+    schedule and (key, pos) fold as :func:`_ring_topk_xla`; real-TPU
+    only — remote DMAs have no CPU interpreter on this jax release."""
+    key, pos, vv, ii, n, B, nq = _prep(v, i, k, select_min, axis)
+    # in-kernel fold arithmetic needs finite sentinels (inf * 0 = NaN)
+    key = jnp.clip(key, -WORST, WORST)
+    vals = jnp.clip(vv, -WORST, WORST)
+    if n == 1:
+        return vv[:nq], ii[:nq]
+    w = k
+    dts = (jnp.float32, jnp.int32)
+    out_v, out_i = pl.pallas_call(
+        functools.partial(_ring_kernel, n, B, w, axis),
+        out_shape=tuple(jax.ShapeDtypeStruct((n * B, w), d) for d in dts),
+        scratch_shapes=kernel_scratch_shapes(n, B, w),
+        compiler_params=pltpu.TPUCompilerParams(collective_id=collective_id),
+    )(key, pos, vals, ii)
+    # restore the inf sentinels the XLA/gather paths report
+    worst = jnp.float32(WORST if select_min else -WORST)
+    inf = jnp.float32(jnp.inf if select_min else -jnp.inf)
+    out_v = jnp.where((out_v == worst) & (out_i < 0), inf, out_v)
+    return out_v[:nq], out_i[:nq]
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def ring_topk(
+    v, i, k: int, *, select_min: bool = True, axis: str = "data",
+    use_fused: bool | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Ring merge of per-shard candidates — call inside a ``shard_map``
+    body where the gather path would ``all_gather`` + ``merge_parts``.
+
+    ``v``/``i`` are the shard-local ``[nq, k_local]`` top-k (ids already
+    global); returns replicated ``(vals [nq, k], ids [nq, k])``
+    bit-identical to ``merge_parts`` over the shard-major concatenation.
+    ``use_fused=None`` picks the Pallas remote-DMA kernel on real TPU
+    and the ``ppermute`` engine elsewhere; failures escape to the
+    caller's ``kernel_guard`` -> gather fallback.
+    """
+    n = axis_size(axis)
+    # trace-time seam: the collective analog of a lost ring participant
+    # (same placement as the comms.all_gather fault point)
+    faults.fire("comms.ring_topk", axis=str(axis), n_shards=int(n))
+    if use_fused is None:
+        use_fused = jax.default_backend() == "tpu"
+    if obs.is_enabled():
+        hops = 2 * max(0, n - 1)
+        B = -(-v.shape[0] // n)
+        rs = (n - 1) * B * k * RS_ENTRY_BYTES
+        ag = (n - 1) * B * k * AG_ENTRY_BYTES
+        obs.inc("comms.ring.hops", hops, axis=str(axis))
+        obs.inc("comms.ring.bytes", float(rs + ag), axis=str(axis), direction="send")
+        obs.inc("comms.ring.bytes", float(rs + ag), axis=str(axis), direction="recv")
+        with obs.span(
+            "ring_topk", axis=str(axis), n_shards=int(n), k=int(k),
+            engine="fused" if use_fused else "xla", traced=True,
+        ):
+            if use_fused:
+                return fused_ring_topk(v, i, k, select_min, axis)
+            return _ring_topk_xla(v, i, k, select_min, axis)
+    if use_fused:
+        return fused_ring_topk(v, i, k, select_min, axis)
+    return _ring_topk_xla(v, i, k, select_min, axis)

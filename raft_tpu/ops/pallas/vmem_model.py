@@ -346,6 +346,54 @@ def cagra_search_residency(
     return KernelResidency("cagra_search._beam_kernel", tuple(residents))
 
 
+def ring_topk_residency(
+    *,
+    n: int,
+    B: int,
+    w: int,
+    fold_rows: int = 32,
+    rank_chunk: int = 64,
+) -> KernelResidency:
+    """Model ``ring_topk._ring_kernel``'s residency. The kernel has no
+    grid — the whole prepped candidate set ([n*B, w] per lane) sits in
+    VMEM — so every in/out ref is single-buffered; the ring state
+    ([n, B, w] per lane) plus the double-buffered send/recv DMA slots
+    ([2, B, w] per lane) are scratch, asserted against
+    ``ring_topk.kernel_scratch_shapes`` (DMA semaphores are not VMEM and
+    are excluded); the body peak is one (key, pos) pairwise-rank chunk
+    of the fold (two i32 ``[fold_rows, 2w, rank_chunk]`` temps live).
+
+    ``n`` = ring size (devices), ``B`` = query-block rows per hop,
+    ``w`` = merge width (k). At the serving shape (n=8, B=128, w=128)
+    the total is ~5.6 MiB — comfortably inside the 0.75 x 16 MiB plan."""
+    residents = [
+        # in refs (prepped key/pos/val/id lanes), then out refs
+        Resident("in_key", (n * B, w), 4),
+        Resident("in_pos", (n * B, w), 4),
+        Resident("in_val", (n * B, w), 4),
+        Resident("in_id", (n * B, w), 4),
+        Resident("out_v", (n * B, w), 4),
+        Resident("out_i", (n * B, w), 4),
+        # scratch_shapes, in declaration order (= kernel_scratch_shapes)
+        Resident("state_key", (n, B, w), 4, kind="scratch"),
+        Resident("state_pos", (n, B, w), 4, kind="scratch"),
+        Resident("state_val", (n, B, w), 4, kind="scratch"),
+        Resident("state_id", (n, B, w), 4, kind="scratch"),
+        Resident("send_key", (2, B, w), 4, kind="scratch"),
+        Resident("send_pos", (2, B, w), 4, kind="scratch"),
+        Resident("send_val", (2, B, w), 4, kind="scratch"),
+        Resident("send_id", (2, B, w), 4, kind="scratch"),
+        Resident("recv_key", (2, B, w), 4, kind="scratch"),
+        Resident("recv_pos", (2, B, w), 4, kind="scratch"),
+        Resident("recv_val", (2, B, w), 4, kind="scratch"),
+        Resident("recv_id", (2, B, w), 4, kind="scratch"),
+        # peak body intermediate: less + tie of one rank chunk
+        Resident("rank_chunk", (fold_rows, 2 * w, rank_chunk), 4, buffers=2,
+                 kind="body"),
+    ]
+    return KernelResidency("ring_topk._ring_kernel", tuple(residents))
+
+
 def ivf_scan_residency(
     *,
     m: int,

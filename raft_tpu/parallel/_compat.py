@@ -24,3 +24,19 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         **{_CHECK_KW: check_vma},
     )
+
+
+def axis_size(axis: str) -> int:
+    """Static size of a named mesh axis, from inside a ``shard_map`` body.
+
+    ``jax.lax.axis_size`` only exists on jax >= 0.5; on 0.4.x the same
+    static value is available through ``jax.core.axis_frame`` (which
+    returns the bare int on that line). Axis sizes are always known at
+    trace time, so both paths return a Python ``int``.
+    """
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(axis))
+    frame = jax.core.axis_frame(axis)
+    return int(frame if isinstance(frame, int) else frame.size)

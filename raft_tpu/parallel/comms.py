@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu import obs
 from raft_tpu.core.errors import expects
 from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.parallel._compat import axis_size as _axis_size
 from raft_tpu.robust import faults
 
 DEFAULT_AXIS = "data"
@@ -58,15 +59,42 @@ def _payload_bytes(x) -> float:
     return float(total)
 
 
+#: Per-verb wire models: bytes a rank actually moves over the fabric for
+#: an input payload of ``p`` bytes on an ``n``-rank axis, assuming XLA's
+#: ring schedules. The allgather family RECEIVES every other rank's block
+#: ((n-1)·p — NOT the p the old accounting charged, and not the n·p the
+#: stacked output shape would suggest); ring allreduce is reduce-scatter
+#: + all-gather (2p(n-1)/n); reducescatter keeps only the scatter half.
+#: Permutation verbs ship one block per rank regardless of n.
+_WIRE_FACTORS = {
+    "allreduce": lambda p, n: 2.0 * p * (n - 1) / n,
+    "reduce": lambda p, n: 2.0 * p * (n - 1) / n,
+    "barrier": lambda p, n: 2.0 * p * (n - 1) / n,
+    "reducescatter": lambda p, n: p * (n - 1) / n,
+    "allgather": lambda p, n: p * (n - 1),
+    "bcast": lambda p, n: p * (n - 1),
+    "gather": lambda p, n: p * (n - 1),
+    "gatherv": lambda p, n: p * (n - 1),
+    "scatter": lambda p, n: p * (n - 1),
+    "multicast_sendrecv": lambda p, n: p * (n - 1),
+    "ppermute": lambda p, n: p,
+    "send_recv": lambda p, n: p,
+    "device_sendrecv": lambda p, n: p,
+}
+
+
 def _instrumented(verb: str):
     """Wrap a comms verb with obs counters + a trace-time span.
 
     Verbs execute while XLA is *tracing* a ``shard_map`` body, so there is
     no device work to sync on here — the span records trace-time only
     (flagged ``traced=True`` in its args) while the counters record call
-    counts and per-rank payload bytes from static shapes. Composite verbs
-    (``reduce`` → ``allreduce``, ``scatter`` → ``bcast``) also count their
-    inner verb: that matches the collectives actually issued."""
+    counts and per-rank bytes MOVED, i.e. the static input payload scaled
+    by the verb's :data:`_WIRE_FACTORS` wire model (outside a named-axis
+    trace, where the axis size is unknowable, the raw payload is counted).
+    Composite verbs (``reduce`` → ``allreduce``, ``scatter`` → ``bcast``)
+    also count their inner verb: that matches the collectives actually
+    issued."""
 
     def deco(fn):
         sig = inspect.signature(fn)
@@ -80,6 +108,12 @@ def _instrumented(verb: str):
             x = bound.arguments.get("x")
             axis = str(bound.arguments.get("axis", DEFAULT_AXIS))
             nbytes = _payload_bytes(x) if x is not None else 4.0
+            try:
+                n = _axis_size(axis)
+            except Exception:  # graft-lint: ignore[silent-except] — outside any axis trace
+                n = None
+            if n and n > 0:
+                nbytes = _WIRE_FACTORS.get(verb, lambda p, _: p)(nbytes, n)
             obs.inc(f"comms.{verb}.calls", axis=axis)
             obs.inc(f"comms.{verb}.bytes", nbytes, axis=axis)
             with obs.span(f"comms.{verb}", bytes=nbytes, axis=axis, traced=True):
@@ -142,7 +176,7 @@ def comm_rank(axis: str = DEFAULT_AXIS) -> jax.Array:
 
 def comm_size(axis: str = DEFAULT_AXIS) -> int:
     """Number of shards along ``axis`` (``comms_t::get_size``)."""
-    return lax.axis_size(axis)
+    return _axis_size(axis)
 
 
 @_instrumented("allreduce")
@@ -273,7 +307,7 @@ def multicast_sendrecv(x, pairs: Sequence[tuple], axis: str = DEFAULT_AXIS):
     one source may feed several destinations — not a permutation, so XLA's
     ppermute cannot express it; an all_gather + per-rank source select
     does (one extra ICI hop vs NCCL's grouped sends)."""
-    size = lax.axis_size(axis)
+    size = _axis_size(axis)
     src_of = np.full((size,), -1, np.int64)
     for s, d in pairs:
         src_of[d] = s

@@ -41,9 +41,11 @@ import functools
 from raft_tpu.core.errors import expects
 from raft_tpu.neighbors import cagra as cagra_mod, ivf_flat as ivf_flat_mod, ivf_pq as ivf_pq_mod
 from raft_tpu.ops.distance import DistanceType
+from raft_tpu.ops.pallas._guard import kernel_guard
 from raft_tpu.ops.select_k import merge_parts, worst_value
 from raft_tpu.parallel._compat import shard_map
 from raft_tpu.random.rng import as_key
+from raft_tpu.robust.fallback import FALLBACK_ERRORS, record_fallback
 
 
 def _health_array(health, n_shards) -> jnp.ndarray:
@@ -54,13 +56,70 @@ def _health_array(health, n_shards) -> jnp.ndarray:
     return h
 
 
+#: candidate-exchange engines for the lists-sharded searches
+_MERGE_MODES = ("auto", "ring", "gather")
+
+
+def _resolve_merge_mode(merge_mode: str, n_shards: int) -> str:
+    """``auto`` prefers the ring exchange whenever there is more than one
+    shard (parity with gather is exact, wire bytes are ~0.4n× lower); a
+    single shard has nothing to exchange and keeps the trivial path."""
+    expects(merge_mode in _MERGE_MODES, "merge_mode %r (want one of %s)",
+            merge_mode, _MERGE_MODES)
+    if merge_mode == "auto":
+        return "ring" if n_shards > 1 else "gather"
+    return merge_mode
+
+
+def _exchange_merge(v, i, k, select_min, axis, merge_mode):
+    """Cross-shard candidate exchange + merge (runs inside ``shard_map``).
+
+    ``ring`` streams each shard's surviving top-k around the ICI ring
+    (:func:`raft_tpu.ops.pallas.ring_topk.ring_topk`), keeping wire bytes
+    and peak memory O(k) per hop; ``gather`` materialises the full
+    ``n_shards × k`` candidate set on every shard and is kept as the
+    reference engine and the ring's fallback target. Ids are bit-identical
+    between the two by the ring's (value, position) total-order contract.
+    """
+    if merge_mode == "ring":
+        from raft_tpu.ops.pallas.ring_topk import ring_topk  # lazy: parallel <-> ops cycle
+
+        return ring_topk(v, i, k, select_min=select_min, axis=axis)
+    nq = v.shape[0]
+    all_v = jax.lax.all_gather(v, axis)  # graft-lint: ignore[gather-merge] — reference engine + ring fallback target
+    all_i = jax.lax.all_gather(i, axis)
+    cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
+    cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
+    # invalid (-1) slots carry +/-inf values and lose the merge
+    return merge_parts(cat_v, cat_i, k, select_min=select_min)
+
+
+def _run_with_ring_fallback(build, args, mode):
+    """Execute the resolved-engine program; a failing ring program
+    (injected ``comms.ring_topk`` chaos, or a real lowering/runtime error
+    on hardware) is re-run on the gather engine. The ring is purely a
+    transport — results are bit-identical — so falling back is always
+    safe, including for explicitly requested ``merge_mode="ring"``
+    (unlike ``mode="fused"`` kernels, where the engine *is* the request).
+    """
+    if mode == "ring":
+        try:
+            with kernel_guard("ring_topk"):
+                return build("ring")(*args)
+        except FALLBACK_ERRORS as exc:
+            record_fallback("ring_topk", exc)
+    return build("gather")(*args)
+
+
 @functools.lru_cache(maxsize=64)
-def _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local, masked=False):
+def _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local, masked=False,
+                 merge_mode="gather"):
     """Cached jitted shard_map program (rebuilding it per call would
     re-trace and recompile every search). With ``masked=True`` the program
     takes an extra replicated ``healthy [n_shards]`` input and unhealthy
-    shards' candidates are demoted to worst-value/-1 before the gather, so
-    the k-way merge drops them (degraded-mode search)."""
+    shards' candidates are demoted to worst-value/-1 before the exchange,
+    so the merge drops them (degraded-mode search; a demoted shard loses
+    every ring fold the same way it loses the gathered merge)."""
 
     def local(centers, ld, li, ln, q, *rest):
         rank = lax.axis_index(axis)
@@ -79,13 +138,7 @@ def _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local, masked=False):
             ok = healthy[rank]
             v = jnp.where(ok, v, worst_value(v.dtype, select_min))
             i = jnp.where(ok, i, -1)
-        all_v = jax.lax.all_gather(v, axis)
-        all_i = jax.lax.all_gather(i, axis)
-        nq = q.shape[0]
-        cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
-        cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
-        # invalid (-1) slots carry +/-inf values and lose the merge
-        return merge_parts(cat_v, cat_i, k, select_min=select_min)
+        return _exchange_merge(v, i, k, select_min, axis, merge_mode)
 
     extra = (P(),) if masked else ()
     return jax.jit(
@@ -107,6 +160,7 @@ def sharded_ivf_flat_search(
     params: Optional["ivf_flat_mod.IvfFlatSearchParams"] = None,
     axis: str = "data",
     health=None,
+    merge_mode: str = "auto",
     **kwargs,
 ) -> Tuple[jax.Array, jax.Array]:
     """IVF-Flat search with lists sharded over ``mesh`` axis ``axis``.
@@ -115,6 +169,10 @@ def sharded_ivf_flat_search(
     the same probed candidate set as single-device scan search. With a
     per-shard boolean ``health`` mask, unhealthy shards are excluded from
     the merge (degraded-mode search; see :mod:`raft_tpu.robust.degrade`).
+    ``merge_mode`` picks the cross-shard exchange: ``"ring"`` (in-VMEM
+    ring top-k), ``"gather"`` (all-gather + merge reference), or
+    ``"auto"`` (ring when sharded, with automatic gather fallback on
+    kernel failure).
     """
     if params is None:
         params = ivf_flat_mod.IvfFlatSearchParams(**kwargs)
@@ -128,7 +186,7 @@ def sharded_ivf_flat_search(
     g = ivf_flat_mod.scan_chunk_lists(l_local, index.max_list)
 
     masked = health is not None
-    fn = _ivf_flat_fn(mesh, axis, k, n_probes, metric, g, l_local, masked)
+    mode = _resolve_merge_mode(merge_mode, n_shards)
     ln = index.list_norms
     if ln is None:
         ln = jnp.zeros(index.list_indices.shape, jnp.float32)
@@ -142,7 +200,10 @@ def sharded_ivf_flat_search(
     ]
     if masked:
         args.append(put(_health_array(health, n_shards), P()))
-    return fn(*args)
+    build = lambda m: _ivf_flat_fn(
+        mesh, axis, k, n_probes, metric, g, l_local, masked, m
+    )
+    return _run_with_ring_fallback(build, args, mode)
 
 
 @functools.lru_cache(maxsize=64)
@@ -225,11 +286,12 @@ def sharded_cagra_search(
 
 
 @functools.lru_cache(maxsize=64)
-def _ivf_pq_lists_fn(mesh, axis, k, n_probes, metric, g, bf16, l_local, masked=False):
+def _ivf_pq_lists_fn(mesh, axis, k, n_probes, metric, g, bf16, l_local, masked=False,
+                     merge_mode="gather"):
     """Lists-sharded PQ search program: replicated centers/quantizers,
-    per-shard decode scan over the local list slice, allgather + merge.
-    ``masked=True`` adds the replicated per-shard health input (see
-    :func:`_ivf_flat_fn`)."""
+    per-shard decode scan over the local list slice, cross-shard exchange
+    + merge (``merge_mode`` engine). ``masked=True`` adds the replicated
+    per-shard health input (see :func:`_ivf_flat_fn`)."""
 
     def local(centers, centers_rot, rotation, pq_centers, codes, li, sqn, q, *rest):
         rank = lax.axis_index(axis)
@@ -264,11 +326,7 @@ def _ivf_pq_lists_fn(mesh, axis, k, n_probes, metric, g, bf16, l_local, masked=F
             ok = healthy[rank]
             v = jnp.where(ok, v, worst_value(v.dtype, select_min))
             i = jnp.where(ok, i, -1)
-        all_v = jax.lax.all_gather(v, axis)
-        all_i = jax.lax.all_gather(i, axis)
-        cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
-        cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
-        return merge_parts(cat_v, cat_i, k, select_min=select_min)
+        return _exchange_merge(v, i, k, select_min, axis, merge_mode)
 
     extra = (P(),) if masked else ()
     return jax.jit(
@@ -290,6 +348,7 @@ def sharded_ivf_pq_lists_search(
     params: Optional["ivf_pq_mod.IvfPqSearchParams"] = None,
     axis: str = "data",
     health=None,
+    merge_mode: str = "auto",
     **kwargs,
 ) -> Tuple[jax.Array, jax.Array]:
     """IVF-PQ search with the CODE LISTS sharded over ``mesh`` axis
@@ -297,7 +356,9 @@ def sharded_ivf_pq_lists_search(
     ``1/n_shards`` of the codes — the scaling mode for datasets beyond one
     chip (SURVEY §7 step 7). Returns replicated ``(distances, indices)``
     from the same probed candidate set as single-device scan search.
-    ``health`` (per-shard bools) excludes failed shards from the merge."""
+    ``health`` (per-shard bools) excludes failed shards from the merge;
+    ``merge_mode`` picks the exchange engine (see
+    :func:`sharded_ivf_flat_search`)."""
     if params is None:
         params = ivf_pq_mod.IvfPqSearchParams(**kwargs)
     expects(
@@ -314,7 +375,7 @@ def sharded_ivf_pq_lists_search(
     bf16 = ivf_pq_mod.scan_bf16(params.lut_dtype)
 
     masked = health is not None
-    fn = _ivf_pq_lists_fn(mesh, axis, k, n_probes, index.metric, g, bf16, l_local, masked)
+    mode = _resolve_merge_mode(merge_mode, n_shards)
     put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
     args = [
         put(index.centers, P()),
@@ -328,7 +389,62 @@ def sharded_ivf_pq_lists_search(
     ]
     if masked:
         args.append(put(_health_array(health, n_shards), P()))
-    return fn(*args)
+    build = lambda m: _ivf_pq_lists_fn(
+        mesh, axis, k, n_probes, index.metric, g, bf16, l_local, masked, m
+    )
+    return _run_with_ring_fallback(build, args, mode)
+
+
+def dist_lloyd_step(centers, x_local, n_lists, axis, cache=None, fuse_comms=True):
+    """One communication-avoiding distributed Lloyd iteration (runs
+    inside ``shard_map``): Flash-KMeans blocked E step on the local rows
+    (``cache`` from :func:`raft_tpu.cluster.kmeans.flash_norm_cache`,
+    hoisted across iterations), then the centroid sums and counts are
+    packed into ONE concatenated ``[n_lists, d+1]`` allreduce instead of
+    two. psum is elementwise, so the packed reduction is bit-identical
+    to the separate pair — the Lloyd trajectory is unchanged
+    (``fuse_comms=False`` keeps the two-allreduce reference for the
+    trajectory/byte-count tests)."""
+    from raft_tpu.cluster.kmeans import flash_min_cluster_and_distance
+    from raft_tpu.parallel.comms import allreduce
+
+    lab, _ = flash_min_cluster_and_distance(
+        x_local, centers, metric=DistanceType.L2Expanded, cache=cache
+    )
+    sums = jax.ops.segment_sum(x_local, lab, num_segments=n_lists)
+    cnts = jax.ops.segment_sum(jnp.ones_like(lab, jnp.float32), lab, num_segments=n_lists)
+    if fuse_comms:
+        packed = allreduce(jnp.concatenate([sums, cnts[:, None]], axis=1), "sum", axis)
+        sums, cnts = packed[:, :-1], packed[:, -1]
+    else:
+        sums = allreduce(sums, "sum", axis)
+        cnts = allreduce(cnts, "sum", axis)
+    new = sums / jnp.maximum(cnts[:, None], 1e-9)
+    return jnp.where(cnts[:, None] > 0, new, centers), lab
+
+
+def dist_codebook_step(books, resid, ksub, axis, fuse_comms=True):
+    """One distributed per-subspace codebook update (runs inside
+    ``shard_map``): local assignment of residual sub-vectors, then the
+    ``[pq_dim, ksub, pq_len]`` sums and ``[pq_dim, ksub]`` counts ride
+    one concatenated allreduce (counts as an extra trailing column),
+    matching :func:`dist_lloyd_step`'s comm fusion bit-for-bit."""
+    from raft_tpu.parallel.comms import allreduce
+
+    dots = jnp.einsum("npl,pkl->npk", resid, books, preferred_element_type=jnp.float32)
+    cn = jnp.sum(books * books, axis=-1)[None, :, :]
+    code = jnp.argmin(cn - 2.0 * dots, axis=-1)  # [nl, pq_dim]
+    oh = jax.nn.one_hot(code, ksub, dtype=jnp.float32)  # [nl, pq_dim, ksub]
+    sums = jnp.einsum("npk,npl->pkl", oh, resid)
+    cnts = jnp.sum(oh, axis=0)  # [pq_dim, ksub]
+    if fuse_comms:
+        packed = allreduce(jnp.concatenate([sums, cnts[..., None]], axis=-1), "sum", axis)
+        sums, cnts = packed[..., :-1], packed[..., -1]
+    else:
+        sums = allreduce(sums, "sum", axis)
+        cnts = allreduce(cnts, "sum", axis)
+    new = sums / jnp.maximum(cnts[..., None], 1e-9)
+    return jnp.where(cnts[..., None] > 0, new, books)
 
 
 def sharded_ivf_pq_build(
@@ -336,13 +452,15 @@ def sharded_ivf_pq_build(
     dataset,
     params: Optional["ivf_pq_mod.IvfPqIndexParams"] = None,
     axis: str = "data",
+    fuse_comms: bool = True,
     **kwargs,
 ) -> "ivf_pq_mod.IvfPqIndex":
     """Distributed IVF-PQ build sketch (SURVEY §7 step 7): dataset rows
     sharded over the mesh, coarse centers and per-subspace codebooks
-    trained with psum-Lloyd (local assign + summed center updates — the
-    allreduce pattern of ``cluster/detail/kmeans_balanced.cuh`` scaled
-    out), then every shard encodes its rows locally and the packed lists
+    trained with psum-Lloyd (local Flash-KMeans assign + summed center
+    updates — the allreduce pattern of ``cluster/detail/kmeans_balanced.cuh``
+    scaled out, with sums+counts fused into one allreduce per iteration),
+    then every shard encodes its rows locally and the packed lists
     are assembled. The returned index is replicated (at DCN scale the
     final allgather would be skipped and the lists kept sharded for
     :func:`sharded_ivf_pq_lists_search`)."""
@@ -362,26 +480,20 @@ def sharded_ivf_pq_build(
     init_centers = dataset[jax.random.permutation(k_init, n)[:n_lists]]
     rotation = ivf_pq_mod._make_rotation(k_rot, rot_dim, d, params.force_random_rotation)
 
-    def lloyd_step(centers, x_local):
-        # local fused assign + psum'd center update (one allreduce per iter)
-        d2 = (
-            jnp.sum(x_local * x_local, axis=1)[:, None]
-            - 2.0 * x_local @ centers.T
-            + jnp.sum(centers * centers, axis=1)[None, :]
-        )
-        lab = jnp.argmin(d2, axis=1)
-        sums = jax.ops.segment_sum(x_local, lab, num_segments=n_lists)
-        cnts = jax.ops.segment_sum(jnp.ones_like(lab, jnp.float32), lab, num_segments=n_lists)
-        sums = lax.psum(sums, axis)
-        cnts = lax.psum(cnts, axis)
-        new = sums / jnp.maximum(cnts[:, None], 1e-9)
-        return jnp.where(cnts[:, None] > 0, new, centers), lab
-
     def train(x_local, centers0):
+        from raft_tpu.cluster.kmeans import flash_norm_cache
+
+        # sample-side norms are iteration-invariant: hoist them out of
+        # the Lloyd loop (the Flash-KMeans cache discipline)
+        cache = flash_norm_cache(x_local, DistanceType.L2Expanded)
         centers = centers0
         for _ in range(params.kmeans_n_iters):
-            centers, _ = lloyd_step(centers, x_local)
-        _, lab = lloyd_step(centers, x_local)
+            centers, _ = dist_lloyd_step(
+                centers, x_local, n_lists, axis, cache=cache, fuse_comms=fuse_comms
+            )
+        _, lab = dist_lloyd_step(
+            centers, x_local, n_lists, axis, cache=cache, fuse_comms=fuse_comms
+        )
         # per-subspace codebooks on local residuals, psum'd updates;
         # seeded from rank 0's first ksub residual rows (a real-data init —
         # random gaussians collapse to few used centers)
@@ -395,20 +507,8 @@ def sharded_ivf_pq_build(
             reps = -(-ksub // n_seed)
             books = jnp.tile(books, (1, reps, 1))[:, :ksub, :]
 
-        def cb_step(books):
-            dots = jnp.einsum("npl,pkl->npk", resid, books, preferred_element_type=jnp.float32)
-            cn = jnp.sum(books * books, axis=-1)[None, :, :]
-            code = jnp.argmin(cn - 2.0 * dots, axis=-1)  # [nl, pq_dim]
-            oh = jax.nn.one_hot(code, ksub, dtype=jnp.float32)  # [nl, pq_dim, ksub]
-            sums = jnp.einsum("npk,npl->pkl", oh, resid)
-            cnts = jnp.sum(oh, axis=0)  # [pq_dim, ksub]
-            sums = lax.psum(sums, axis)
-            cnts = lax.psum(cnts, axis)
-            new = sums / jnp.maximum(cnts[..., None], 1e-9)
-            return jnp.where(cnts[..., None] > 0, new, books)
-
         for _ in range(max(4, params.kmeans_n_iters)):
-            books = cb_step(books)
+            books = dist_codebook_step(books, resid, ksub, axis, fuse_comms=fuse_comms)
         return centers, books
 
     fn = jax.jit(

@@ -79,6 +79,7 @@ def sharded_search_degraded(
     axis: str = "data",
     health: Optional[Sequence[bool]] = None,
     min_coverage: float = 0.0,
+    merge_mode: str = "auto",
     **kwargs,
 ) -> DegradedResult:
     """Lists-sharded search that tolerates failed shards.
@@ -87,7 +88,10 @@ def sharded_search_degraded(
     overrides probing (``None`` → probe via the fault point). Raises
     :class:`ShardFailure` only when no shard is healthy or coverage falls
     below ``min_coverage`` — otherwise returns a :class:`DegradedResult`
-    whose candidates come from the surviving shards only.
+    whose candidates come from the surviving shards only. ``merge_mode``
+    picks the cross-shard exchange engine (``"auto"`` | ``"ring"`` |
+    ``"gather"``); demoted shards lose every ring fold exactly as they
+    lose the gathered merge, so coverage masking is engine-independent.
     """
     from raft_tpu.parallel import sharded_ann
 
@@ -125,7 +129,7 @@ def sharded_search_degraded(
     # all-healthy uses the unmasked (pre-existing, bit-identical) program
     d, i = search(
         mesh, index, queries, k, params=params, axis=axis,
-        health=health if degraded else None, **kwargs,
+        health=health if degraded else None, merge_mode=merge_mode, **kwargs,
     )
     return DegradedResult(
         distances=d, indices=i, coverage=coverage,

@@ -69,6 +69,7 @@ def is_enabled() -> bool:
 #: host-level ``fire(...)`` call in the serving stack
 FAULT_POINTS = (
     "comms.all_gather",       # parallel/comms.py allgather verb (trace time)
+    "comms.ring_topk",        # ops/pallas/ring_topk.py ring dispatch (trace time)
     "sharded_ann.shard_scan", # robust/degrade.py per-shard health probe
     "pallas.cagra_search",    # neighbors/cagra.py fused dispatch branch
     "pallas.pq_scan",         # neighbors/ivf_pq.py fused dispatch branch

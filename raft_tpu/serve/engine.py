@@ -103,6 +103,9 @@ class _Registration:
     mesh: object = None
     axis: str = "data"
     min_coverage: float = 0.0
+    #: cross-shard exchange engine for the sharded algos
+    #: ("auto" | "ring" | "gather"; see parallel/sharded_ann.py)
+    merge_mode: str = "auto"
     search_kwargs: Dict[str, object] = dataclasses.field(default_factory=dict)
 
 
@@ -152,6 +155,7 @@ class ServingEngine:
         mesh=None,
         axis: str = "data",
         min_coverage: float = 0.0,
+        merge_mode: str = "auto",
         **search_kwargs,
     ) -> None:
         """Register ``index`` under ``index_id``.
@@ -161,9 +165,11 @@ class ServingEngine:
         ``params``/``mode``/``search_kwargs`` are pinned at registration
         and become part of every program key; ``dataset`` enables
         IVF-PQ exact re-ranking; ``mesh`` is required for the sharded
-        algos and ``min_coverage`` is their floor (below it the request
+        algos, ``min_coverage`` is their floor (below it the request
         fails with :class:`~raft_tpu.core.errors.ShardFailure` rather
-        than return near-empty results).
+        than return near-empty results), and ``merge_mode`` pins their
+        cross-shard exchange engine (``"auto"`` | ``"ring"`` |
+        ``"gather"``).
         """
         expects(algo in _DEFAULT_MODES, "unknown serving algo %r (want one of %s)",
                 algo, ", ".join(sorted(_DEFAULT_MODES)))
@@ -179,6 +185,7 @@ class ServingEngine:
             mesh=mesh,
             axis=axis,
             min_coverage=min_coverage,
+            merge_mode=merge_mode,
             search_kwargs=dict(search_kwargs),
         )
 
@@ -417,7 +424,8 @@ class ServingEngine:
             return sharded_search_degraded(
                 reg.mesh, reg.index, q, k,
                 algo=algo, params=reg.params, axis=reg.axis,
-                health=health, min_coverage=reg.min_coverage, **kw,
+                health=health, min_coverage=reg.min_coverage,
+                merge_mode=reg.merge_mode, **kw,
             )
 
         return sharded_prog

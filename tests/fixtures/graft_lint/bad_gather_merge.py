@@ -1,0 +1,19 @@
+"""Seeded violation: all_gather of the per-shard candidate val/idx pair
+followed by a top-k merge of the concatenation.
+
+Expected: exactly one ``gather-merge`` on the marked line (the first
+all_gather of the pair).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def exchange_and_merge(vals, idx, k, axis):
+    all_v = lax.all_gather(vals, axis)  # LINT-HERE
+    all_i = lax.all_gather(idx, axis)
+    nq = vals.shape[0]
+    cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
+    cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
+    top_v, pos = jax.lax.top_k(-cat_v, k)
+    return -top_v, jnp.take_along_axis(cat_i, pos, axis=1)

@@ -115,3 +115,17 @@ def tiled_copy(x):
         out_specs=pl.BlockSpec((256, 128), lambda i: (i, 0)),
         scratch_shapes=[pltpu.VMEM((256, 128), jnp.float32)],
     )(x)
+
+
+def broadcast_sizes(sizes, axis):
+    # gather-merge negative space: a single all_gather with no top-k
+    # consumer is a verb implementation detail, not a candidate exchange
+    return jax.lax.all_gather(sizes, axis)
+
+
+def gather_then_pick(blocks, sizes, root, axis):
+    # two all_gathers but no merge over the concatenation (the gatherv
+    # shape): also fine
+    b = jax.lax.all_gather(blocks, axis)
+    s = jax.lax.all_gather(sizes, axis)
+    return b[root], s[root]

@@ -9,17 +9,10 @@ import numpy as np
 import pytest
 
 import jax
-
-if not hasattr(jax, "shard_map") or not hasattr(jax.lax, "axis_size"):
-    pytest.skip(
-        "comms verbs need the jax>=0.5 shard_map/axis_size API "
-        f"(running {jax.__version__})",
-        allow_module_level=True,
-    )
-
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.parallel._compat import shard_map
 
 from raft_tpu.parallel import comms
 from raft_tpu.parallel.sharded_knn import sharded_knn

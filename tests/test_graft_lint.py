@@ -31,6 +31,7 @@ BAD = {
     "bad_vmem_budget.py": "vmem-budget",
     "bad_vmem_unmodeled.py": "vmem-unmodeled",
     "bad_silent_except.py": "silent-except",
+    "bad_gather_merge.py": "gather-merge",
     "bad_unbounded_queue.py": "unbounded-queue",
     "bad_non_atomic_write.py": "non-atomic-write",
 }

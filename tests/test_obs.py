@@ -319,11 +319,13 @@ def test_comms_verbs_instrumented(eight_devices, obs_on):
     out = jax.jit(f)(jnp.arange(16, dtype=jnp.float32))
     jax.block_until_ready(out)
     snap = obs_on.as_dict()
-    # 16 f32 over 8 shards -> 2 elements = 8 bytes per rank, counted once
-    # at trace time (not per device)
+    # 16 f32 over 8 shards -> payload p = 8 bytes per rank, counted once
+    # at trace time (not per device) and scaled to bytes MOVED by the
+    # verb's wire model: ring allreduce = 2p(n-1)/n = 14, allgather
+    # receives the 7 other ranks' blocks = 7p = 56
     assert snap["counters"]['comms.allreduce.calls{axis="data"}'] == 1.0
-    assert snap["counters"]['comms.allreduce.bytes{axis="data"}'] == 8.0
-    assert snap["counters"]['comms.allgather.bytes{axis="data"}'] == 8.0
+    assert snap["counters"]['comms.allreduce.bytes{axis="data"}'] == 14.0
+    assert snap["counters"]['comms.allgather.bytes{axis="data"}'] == 56.0
     assert snap["counters"]['comms.barrier.calls{axis="data"}'] == 1.0
     names = {s["name"] for s in obs_on.spans()}
     assert {"comms.allreduce", "comms.allgather", "comms.barrier"} <= names

@@ -343,12 +343,15 @@ class TestDegradedSearch:
         d, i = res
         assert np.asarray(i).shape == (Q.shape[0], self.K)
 
+    @pytest.mark.parametrize("merge_mode", ["ring", "gather"])
     @pytest.mark.parametrize("algo", ["ivf_flat", "ivf_pq_lists"])
-    def test_one_shard_lost_degrades_not_fails(self, degraded_setup, chaos_obs, algo):
+    def test_one_shard_lost_degrades_not_fails(
+        self, degraded_setup, chaos_obs, algo, merge_mode
+    ):
         mesh, flat, pq, Q, exact = degraded_setup
         index = flat if algo == "ivf_flat" else pq
         healthy = sharded_search_degraded(
-            mesh, index, Q, self.K, algo=algo, n_probes=16
+            mesh, index, Q, self.K, algo=algo, n_probes=16, merge_mode=merge_mode
         )
         healthy_recall = float(neighborhood_recall(np.asarray(healthy.indices), exact))
         with faults.injected(
@@ -356,7 +359,9 @@ class TestDegradedSearch:
             ShardFailure("chaos", shard=1),
             match={"shard": 1},
         ):
-            res = sharded_search_degraded(mesh, index, Q, self.K, algo=algo, n_probes=16)
+            res = sharded_search_degraded(
+                mesh, index, Q, self.K, algo=algo, n_probes=16, merge_mode=merge_mode
+            )
         assert res.degraded and res.coverage == 0.75
         assert res.failed_shards == (1,)
         recall = float(neighborhood_recall(np.asarray(res.indices), exact))
@@ -383,13 +388,37 @@ class TestDegradedSearch:
         snap = chaos_obs.as_dict()
         assert snap["counters"]['robust.queries_failed{algo="ivf_flat"}'] == 1.0
 
-    def test_min_coverage_enforced(self, degraded_setup):
+    @pytest.mark.parametrize("merge_mode", ["ring", "gather"])
+    def test_min_coverage_enforced(self, degraded_setup, merge_mode):
         mesh, flat, _pq, Q, _exact = degraded_setup
         with pytest.raises(ShardFailure):
             sharded_search_degraded(
                 mesh, flat, Q, self.K,
                 health=(True, False, True, True), min_coverage=0.9, n_probes=16,
+                merge_mode=merge_mode,
             )
+
+    @pytest.mark.parametrize("merge_mode", ["ring", "gather"])
+    def test_masked_shard_parity_across_merge_modes(self, degraded_setup, merge_mode):
+        """Under a killed shard, the degraded result is bit-identical in
+        ids whichever transport carried the exchange (masked shards
+        forward worst-sentinel candidates that lose every ring fold)."""
+        mesh, flat, _pq, Q, _exact = degraded_setup
+        res = sharded_search_degraded(
+            mesh, flat, Q, self.K,
+            health=(True, False, True, True), n_probes=16, merge_mode=merge_mode,
+        )
+        ref = sharded_search_degraded(
+            mesh, flat, Q, self.K,
+            health=(True, False, True, True), n_probes=16, merge_mode="gather",
+        )
+        assert res.coverage == 0.75 and res.failed_shards == (1,)
+        np.testing.assert_array_equal(
+            np.asarray(res.indices), np.asarray(ref.indices)
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.distances), np.asarray(ref.distances), atol=1e-6
+        )
 
     def test_explicit_health_mask_skips_probe(self, degraded_setup):
         mesh, flat, _pq, Q, _exact = degraded_setup

@@ -396,14 +396,18 @@ class TestGateParity:
 # -- engine: degraded sharded serving (acceptance d) -------------------------
 
 
-@pytest.fixture
-def sharded_engine(eight_devices, corpus):
+@pytest.fixture(params=["ring", "gather"])
+def sharded_engine(request, eight_devices, corpus):
+    """Every degraded-serving test runs once per exchange transport:
+    the ring path must mask/fall back under chaos exactly like the
+    gather reference (no hang on a semaphore, same coverage floor)."""
     X, Q = corpus
     mesh = make_mesh(eight_devices[:4])
     flat = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=64, seed=1))
     eng = ServingEngine(max_batch=16, max_wait_ms=0.0, queue_capacity=256,
                         slow_shard_s=0.05)
-    eng.register("shards", "sharded_ivf_flat", flat, mesh=mesh, n_probes=16)
+    eng.register("shards", "sharded_ivf_flat", flat, mesh=mesh, n_probes=16,
+                 merge_mode=request.param)
     return eng, Q
 
 
@@ -444,13 +448,14 @@ class TestDegradedServing:
         assert res.degraded and res.coverage == pytest.approx(0.75)
         assert res.failed_shards == (1,)
 
-    def test_min_coverage_floor_fails_typed(self, eight_devices, corpus):
+    @pytest.mark.parametrize("merge_mode", ["ring", "gather"])
+    def test_min_coverage_floor_fails_typed(self, eight_devices, corpus, merge_mode):
         X, Q = corpus
         mesh = make_mesh(eight_devices[:4])
         flat = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=64, seed=1))
         eng = ServingEngine(max_batch=16, max_wait_ms=0.0)
         eng.register("shards", "sharded_ivf_flat", flat, mesh=mesh,
-                     min_coverage=0.9, n_probes=16)
+                     min_coverage=0.9, n_probes=16, merge_mode=merge_mode)
         with faults.injected(
             "sharded_ann.shard_scan",
             ShardFailure("chaos", shard=0),

@@ -1,0 +1,81 @@
+"""Distributed-communication checkers.
+
+The sharded search paths earned an O(k)-per-hop ring exchange
+(:mod:`raft_tpu.ops.pallas.ring_topk`); the anti-pattern it replaces is
+easy to reintroduce:
+
+* ``gather-merge`` — a function that ``all_gather`` s two or more
+  per-shard candidate arrays (the val/idx pair) and then runs a
+  top-k/sort/merge over the concatenation. Every rank receives
+  ``(n-1) x payload`` bytes and materialises the full
+  ``n_shards x k`` candidate set just to throw most of it away — the
+  communication-avoiding form is ``ring_topk`` (bit-identical ids).
+  The intentional gather sites — the parity reference engine and the
+  ring's fallback target — carry a rationale'd
+  ``# graft-lint: ignore[gather-merge]``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graft_lint.core import Checker, LintModule, Violation
+
+#: call names that consume a gathered candidate set as a merge/top-k
+_MERGE_CALLS = frozenset(
+    {"top_k", "approx_max_k", "approx_min_k", "merge_parts", "select_k",
+     "sort", "argsort"}
+)
+
+
+def _attr_name(node: ast.Call) -> str:
+    """Trailing name of ``f(...)`` / ``a.b.f(...)`` — matching on the
+    last attribute keeps the check alias-robust (``lax.all_gather`` and
+    ``jax.lax.all_gather`` both end in ``all_gather``)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+class GatherMergeChecker(Checker):
+    rule = "gather-merge"
+    doc = (
+        "all_gather of per-shard candidate val/idx pairs followed by a "
+        "top-k/sort merge — O(n_shards·k) wire and memory per rank; use "
+        "ring_topk (bit-identical ids, O(k) per hop) or suppress the "
+        "intentional gather fallback with a rationale"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            gathers = []
+            merges = 0
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _attr_name(sub)
+                if name in ("all_gather", "allgather"):
+                    gathers.append(sub)
+                elif name in _MERGE_CALLS:
+                    merges += 1
+            # one gather is a verb/bcast implementation detail (comms.py's
+            # own wrappers); the candidate-exchange smell needs the
+            # val/idx PAIR gathered and then merged
+            if len(gathers) >= 2 and merges:
+                yield self.violation(
+                    module, gathers[0],
+                    f"{node.name} all_gathers {len(gathers)} per-shard "
+                    "arrays and merges the concatenation — every rank "
+                    "pays O(n_shards·k) wire/memory; use "
+                    "ops.pallas.ring_topk.ring_topk (bit-identical ids) "
+                    "or add a rationale'd suppression on the intentional "
+                    "gather fallback",
+                )
+
+
+CHECKERS = [GatherMergeChecker()]

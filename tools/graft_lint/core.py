@@ -117,9 +117,14 @@ class LintModule:
 
 def all_checkers() -> List[Checker]:
     """The default checker set, import-cycle-free registry."""
-    from tools.graft_lint import jax_rules, pallas_rules, robust_rules
+    from tools.graft_lint import comms_rules, jax_rules, pallas_rules, robust_rules
 
-    return [*jax_rules.CHECKERS, *pallas_rules.CHECKERS, *robust_rules.CHECKERS]
+    return [
+        *jax_rules.CHECKERS,
+        *pallas_rules.CHECKERS,
+        *robust_rules.CHECKERS,
+        *comms_rules.CHECKERS,
+    ]
 
 
 def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
