@@ -1107,16 +1107,21 @@ def _bench_main():
     # ---- mutable churn: sustained insert/delete while serving ------------
     # one mutable ivf_flat index under write pressure: every tick inserts
     # and deletes a fixed batch, then serves a query batch through the
-    # engine. Two ticks trigger synchronous compaction, so the queued
-    # request's latency includes the rebuild — that p99 spike is the
-    # honest cost of the current lock-held compaction (docs/mutability.md).
+    # engine. The phase runs twice: compaction="sync" rebuilds under the
+    # index lock on the serving thread (the queued request's latency
+    # includes the whole rebuild — the honest p99_compact_ms spike), and
+    # compaction="background" hands the same ticks to a Compactor worker
+    # so serving continues through the rebuild (docs/mutability.md). The
+    # background row's p99_compact_ms is the p99 over ticks served WHILE
+    # a rebuild was in flight, and the in-bench assertion below is the
+    # claim: that number must not contain the rebuild.
     # recall is measured against a from-scratch rebuild over the final
     # live rows (ground truth for the original corpus is stale by then).
     if over_budget(0.94):
         print("# mutable_churn skipped: time budget", flush=True)
     else:
         try:
-            from raft_tpu.mutable import MutableIndex
+            from raft_tpu.mutable import Compactor, MutableIndex
             from raft_tpu.serve import ServingEngine as _MutEngine
 
             m_smoke = bool(os.environ.get("RAFT_TPU_BENCH_SMOKE"))
@@ -1126,66 +1131,127 @@ def _bench_main():
             base = np.asarray(dataset[:mn], np.float32)
             mparams = ivf_flat.IvfFlatIndexParams(n_lists=16 if m_smoke else 128)
             msearch = ivf_flat.IvfFlatSearchParams(n_probes=16 if m_smoke else 32)
-            mut = MutableIndex("ivf_flat", dim, index_params=mparams,
-                               search_params=msearch, name="churn")
-            live_pool = [int(x) for x in mut.insert(base)]
-            mut.compact()
-            meng = _MutEngine(max_batch=64, max_wait_ms=0.5)
-            meng.register_mutable("churn", mut)
-            meng.warmup("churn", K)
-            crng = np.random.default_rng(7)
             qpool_m = np.asarray(queries, np.float32)
-            lat, lat_compact = [], []
             compact_at = {ticks // 3, (2 * ticks) // 3}
-            rows_served = 0
-            for t in range(ticks):
-                fresh = base[crng.integers(0, mn, wb)] + 0.01 * crng.standard_normal(
-                    (wb, dim)).astype(np.float32)
-                new_ids = mut.insert(fresh)
-                kill = sorted(crng.choice(len(live_pool), wb, replace=False),
-                              reverse=True)
-                mut.delete(np.asarray([live_pool[j] for j in kill], np.int64))
-                for j in kill:
-                    live_pool.pop(j)
-                live_pool.extend(int(x) for x in new_ids)
-                off = (t * 8) % (nq - 8)
-                t0 = time.perf_counter()
-                fut = meng.submit("churn", qpool_m[off : off + 8], K)
-                if t in compact_at:
-                    mut.compact()  # the queued request rides out the rebuild
-                meng.run_until_idle()
-                fut.result()
-                (lat_compact if t in compact_at else lat).append(
-                    time.perf_counter() - t0)
-                rows_served += 8
-            serve_s = sum(lat) + sum(lat_compact)
-            live_ids, live_vecs = mut.live_rows()
-            d_mut, i_mut = mut.search(qpool_m[:128], K)
-            fresh_idx = ivf_flat.build(live_vecs, params=mparams)
-            _, pos = ivf_flat.search(fresh_idx, qpool_m[:128], K, msearch)
-            i_ref = live_ids[np.clip(np.asarray(pos), 0, None)]
-            overlap = float(np.mean([
-                len(set(i_mut[r]) & set(i_ref[r])) / K for r in range(len(i_mut))
-            ]))
-            churn_row = {
-                "config": f"ivf_flat n={mn} ticks={ticks} writes/tick={2*wb}",
-                "qps": round(rows_served / serve_s, 1),
-                "recall": round(overlap, 4),
-                "p50_ms": round(1e3 * float(np.percentile(lat, 50)), 2),
-                "p99_ms": round(1e3 * float(np.percentile(lat, 99)), 2),
-                "p99_compact_ms": round(1e3 * float(np.max(lat_compact)), 2),
-                "generations": int(mut.generation),
-                "tombstone_fraction": round(mut.tombstone_fraction, 4),
-            }
-            results.setdefault("mutable_churn", []).append(churn_row)
-            _rec_add({"algo": "mutable_churn", **churn_row})
-            mcs = meng.cache.stats()
-            print(f"# mutable_churn    {churn_row['config']:<34s}"
-                  f" {churn_row['qps']:>8} qps  recall-vs-rebuild={overlap:.4f}"
-                  f"  p99={churn_row['p99_ms']:.2f}"
-                  f" p99_compact={churn_row['p99_compact_ms']:.2f} ms"
-                  f"  gens={mut.generation} programs={mcs.distinct_programs}",
-                  flush=True)
+
+            def _run_churn(compaction):
+                mut = MutableIndex("ivf_flat", dim, index_params=mparams,
+                                   search_params=msearch,
+                                   name=f"churn-{compaction}")
+                live_pool = [int(x) for x in mut.insert(base)]
+                mut.compact()
+                comp = (Compactor(mut, poll_interval_s=0.001,
+                                  name=f"churn-{compaction}")
+                        if compaction == "background" else None)
+                meng = _MutEngine(max_batch=64, max_wait_ms=0.5,
+                                  maintenance_interval_ms=0.0)
+                meng.register_mutable("churn", mut, compactor=comp)
+                meng.warmup("churn", K)
+                crng = np.random.default_rng(7)
+                lat, lat_compact = [], []
+                rows_served = 0
+                for t in range(ticks):
+                    fresh = base[crng.integers(0, mn, wb)] \
+                        + 0.01 * crng.standard_normal((wb, dim)).astype(np.float32)
+                    new_ids = mut.insert(fresh)
+                    kill = sorted(crng.choice(len(live_pool), wb, replace=False),
+                                  reverse=True)
+                    mut.delete(np.asarray([live_pool[j] for j in kill], np.int64))
+                    for j in kill:
+                        live_pool.pop(j)
+                    live_pool.extend(int(x) for x in new_ids)
+                    if comp is not None and t in compact_at:
+                        comp.request()  # the worker rebuilds; serving goes on
+                    off = (t * 8) % (nq - 8)
+                    # the delta pads to a power of two (log2 distinct
+                    # shapes, segments.py); a tick that crosses a pad
+                    # boundary pays an XLA compile. That is the bounded
+                    # program-population cost (docs/serving.md), not
+                    # serving latency — absorb it with one untimed warm
+                    # request so the timed tick below measures serving in
+                    # both variants. A rebuild holding the lock would
+                    # stall the timed request all the same.
+                    warm = meng.submit("churn", qpool_m[off : off + 8], K)
+                    meng.run_until_idle()
+                    warm.result()
+                    in_compact = comp.busy() if comp is not None else t in compact_at
+                    t0 = time.perf_counter()
+                    fut = meng.submit("churn", qpool_m[off : off + 8], K)
+                    if comp is None and t in compact_at:
+                        mut.compact()  # the queued request rides out the rebuild
+                    meng.run_until_idle()
+                    fut.result()
+                    dt = time.perf_counter() - t0
+                    if comp is not None:
+                        in_compact = in_compact or comp.busy()
+                    (lat_compact if in_compact else lat).append(dt)
+                    rows_served += 8
+                if comp is not None:
+                    comp.wait_idle(timeout_s=600.0)
+                    meng.shutdown()
+                serve_s = sum(lat) + sum(lat_compact)
+                live_ids, live_vecs = mut.live_rows()
+                d_mut, i_mut = mut.search(qpool_m[:128], K)
+                fresh_idx = ivf_flat.build(live_vecs, params=mparams)
+                _, pos = ivf_flat.search(fresh_idx, qpool_m[:128], K, msearch)
+                i_ref = live_ids[np.clip(np.asarray(pos), 0, None)]
+                overlap = float(np.mean([
+                    len(set(i_mut[r]) & set(i_ref[r])) / K
+                    for r in range(len(i_mut))
+                ]))
+                row = {
+                    "config": f"ivf_flat n={mn} ticks={ticks} writes/tick={2*wb}",
+                    "compaction": compaction,
+                    "qps": round(rows_served / serve_s, 1),
+                    "recall": round(overlap, 4),
+                    "p50_ms": round(1e3 * float(np.percentile(lat, 50)), 2),
+                    "p99_ms": round(1e3 * float(np.percentile(lat, 99)), 2),
+                    "p99_compact_ms": round(
+                        1e3 * float(np.max(lat_compact)), 2
+                    ) if lat_compact else 0.0,
+                    "generations": int(mut.generation),
+                    "tombstone_fraction": round(mut.tombstone_fraction, 4),
+                }
+                return row, meng.cache.stats()
+
+            rows_by_mode = {}
+            for compaction in ("sync", "background"):
+                if compaction == "background" and over_budget(0.97):
+                    print("# mutable_churn background skipped: time budget",
+                          flush=True)
+                    break
+                churn_row, mcs = _run_churn(compaction)
+                rows_by_mode[compaction] = churn_row
+                results.setdefault("mutable_churn", []).append(churn_row)
+                _rec_add({"algo": "mutable_churn", **churn_row})
+                print(f"# mutable_churn    {compaction:<10s}"
+                      f" {churn_row['qps']:>8} qps"
+                      f"  recall-vs-rebuild={churn_row['recall']:.4f}"
+                      f"  p99={churn_row['p99_ms']:.2f}"
+                      f" p99_compact={churn_row['p99_compact_ms']:.2f} ms"
+                      f"  gens={churn_row['generations']}"
+                      f" programs={mcs.distinct_programs}",
+                      flush=True)
+            if {"sync", "background"} <= set(rows_by_mode):
+                sync_row = rows_by_mode["sync"]
+                bg_row = rows_by_mode["background"]
+                # the serve-through-rebuilds claim, asserted in-bench: a
+                # query served while the background rebuild runs must not
+                # ride the rebuild out. Bounded by 5x the variant's own
+                # steady-state p99 (scheduler noise) or half the sync
+                # rebuild spike, whichever is looser.
+                bound = max(5.0 * bg_row["p99_ms"],
+                            0.5 * sync_row["p99_compact_ms"])
+                assert bg_row["p99_compact_ms"] <= bound, (
+                    "background compaction leaked the rebuild into serving: "
+                    f"p99 during compaction {bg_row['p99_compact_ms']:.2f} ms "
+                    f"> bound {bound:.2f} ms (sync rebuild spike "
+                    f"{sync_row['p99_compact_ms']:.2f} ms)")
+                print("# mutable_churn    background p99 during compaction "
+                      f"{bg_row['p99_compact_ms']:.2f} ms vs sync rebuild "
+                      f"spike {sync_row['p99_compact_ms']:.2f} ms "
+                      f"(bound {bound:.2f})",
+                      flush=True)
         except Exception as e:  # noqa: BLE001
             phase_errors["mutable_churn"] = f"{type(e).__name__}: {e}"[:200]
             print(f"# mutable_churn failed: {phase_errors['mutable_churn']}",

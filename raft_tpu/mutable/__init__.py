@@ -5,21 +5,31 @@ generation-numbered main segment (any index type, tombstones masked
 in-scan) plus a small brute-force delta segment for fresh rows; every
 mutation is WAL-durable before it is visible
 (:mod:`~raft_tpu.mutable.wal`); compaction rebuilds and atomically
-publishes the next generation (:mod:`~raft_tpu.mutable.compact`,
-:mod:`~raft_tpu.mutable.manifest`). See ``docs/mutability.md``.
+publishes the next generation — foreground under the lock
+(:mod:`~raft_tpu.mutable.compact`) or pinned-snapshot background with
+catch-up replay (:mod:`~raft_tpu.mutable.maintenance`), both through
+:mod:`~raft_tpu.mutable.manifest`. See ``docs/mutability.md``.
 """
 from raft_tpu.mutable.compact import compact
+from raft_tpu.mutable.maintenance import (
+    CompactionPolicy,
+    Compactor,
+    compact_background,
+)
 from raft_tpu.mutable.manifest import Manifest
 from raft_tpu.mutable.segments import MutableIndex, Snapshot
 from raft_tpu.mutable.wal import WalRecord, WriteAheadLog, replay, segment_paths
 
 __all__ = [
+    "CompactionPolicy",
+    "Compactor",
     "Manifest",
     "MutableIndex",
     "Snapshot",
     "WalRecord",
     "WriteAheadLog",
     "compact",
+    "compact_background",
     "replay",
     "segment_paths",
 ]

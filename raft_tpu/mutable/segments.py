@@ -405,6 +405,16 @@ class MutableIndex:
         self.metric = resolve_metric(metric)
         self.name = name or (os.path.basename(directory) if directory else "mutable")
         self._lock = threading.RLock()
+        # lock ordering: _compact_mutex (if taken) strictly before _lock.
+        # It serializes whole compactions (foreground or background) so
+        # two rebuilds can never race a generation number, while writers
+        # and searchers keep taking _lock alone.
+        self._compact_mutex = threading.Lock()
+        #: when a background compaction is between pin and flip, every
+        #: applied mutation is also recorded here so the in-memory
+        #: (directory=None) catch-up replay has a source of truth; the
+        #: directory-backed path reads the WAL instead
+        self._capture: Optional[List[WalRecord]] = None
         # main segment state
         self.main_index = None
         self.main_data = np.zeros((0, dim), np.float32)
@@ -598,6 +608,10 @@ class MutableIndex:
     # -- application (shared by live mutation and WAL replay) --------------
 
     def _apply(self, rec: WalRecord) -> int:
+        if self._capture is not None:
+            # a background compaction pinned before this mutation: queue
+            # it for the catch-up replay into the new generation
+            self._capture.append(rec)
         if rec.op == "insert":
             self._apply_rows(rec.ids, rec.vectors, replace=False)
             if obs.is_enabled():
@@ -725,6 +739,16 @@ class MutableIndex:
         from raft_tpu.mutable.compact import compact
 
         return compact(self, res=res)
+
+    def compact_background(self, res=None, _mid_rebuild=None) -> int:
+        """One off-lock compaction on the calling thread: pin, rebuild
+        without the lock, catch-up + flip under a brief lock (see
+        :func:`raft_tpu.mutable.maintenance.compact_background`).
+        Production callers want a :class:`~raft_tpu.mutable.maintenance.
+        Compactor` worker instead."""
+        from raft_tpu.mutable.maintenance import compact_background
+
+        return compact_background(self, res=res, _mid_rebuild=_mid_rebuild)
 
     def close(self) -> None:
         with self._lock:

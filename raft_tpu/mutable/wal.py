@@ -120,18 +120,21 @@ def segment_paths(path: str) -> List[str]:
     return [sp for _, sp in _list_segments(path) if os.path.exists(sp)]
 
 
-def replay(path: str) -> Tuple[List[WalRecord], int]:
+def replay(path: str, start: int = 0) -> Tuple[List[WalRecord], int]:
     """Read the longest valid prefix of the log at ``path``.
 
     Returns ``(records, good_offset)`` where ``good_offset`` is the byte
     offset just past the last whole, CRC-clean frame. Anything beyond it
-    is a torn tail (truncated header, truncated payload, magic or CRC
+    is a torn tail (truncated header, truncated payload, magic or bit
     damage) — counted in ``mutable.wal.torn_tail_bytes`` and meant to be
     truncated away by :meth:`WriteAheadLog.open`. A missing file is an
-    empty log.
+    empty log. ``start`` skips to a byte offset that must sit on a frame
+    boundary (e.g. one recorded by :meth:`WriteAheadLog.position`) —
+    background compaction uses it to read only the records that landed
+    after its pin.
     """
     records: List[WalRecord] = []
-    good = 0
+    good = start
     if not os.path.exists(path):
         return records, good
     with open(path, "rb") as f:
@@ -154,7 +157,7 @@ def replay(path: str) -> Tuple[List[WalRecord], int]:
             # still a torn/foreign tail — stop at the last good record
             break
         good += _HEADER.size + length
-    torn = n - good
+    torn = max(n - good, 0)
     if torn and obs.is_enabled():
         obs.inc("mutable.wal.torn_tail_bytes", float(torn))
     return records, good
@@ -234,6 +237,39 @@ class WriteAheadLog:
     def segment_paths(self) -> List[str]:
         """Existing segment files of this log, sequence order."""
         return segment_paths(self.path)
+
+    def position(self) -> Tuple[int, int]:
+        """The durable high-water mark ``(segment, offset)`` — always a
+        frame boundary. Background compaction records it at pin time;
+        :meth:`read_from` later returns exactly the records appended
+        after it."""
+        return (self._seq, self._offset)
+
+    def read_from(self, pos: Tuple[int, int]) -> List[WalRecord]:
+        """Every record appended after ``pos`` (a :meth:`position`
+        result): the tail of that segment plus all later segments, in
+        order. The durable source of truth for compaction catch-up —
+        what landed on disk is what replays, regardless of what any
+        in-memory view saw."""
+        seq0, off0 = pos
+        records: List[WalRecord] = []
+        for sq, sp in _list_segments(self.path):
+            if sq < seq0:
+                continue
+            recs, _ = replay(sp, start=off0 if sq == seq0 else 0)
+            records.extend(recs)
+        return records
+
+    def total_bytes(self) -> int:
+        """Bytes on disk across all segments — the ``wal_bytes``
+        auto-compaction trigger reads this."""
+        total = 0
+        for sp in self.segment_paths():
+            try:
+                total += os.path.getsize(sp)
+            except OSError:  # graft-lint: ignore[silent-except] — raced unlink; size is advisory
+                pass
+        return total
 
     def _rotate(self) -> None:
         """Seal the active segment and start the next one. Called only

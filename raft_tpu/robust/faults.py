@@ -79,6 +79,10 @@ FAULT_POINTS = (
     "wal.append",             # mutable/wal.py durable append (stage pre/post)
     "compact.merge",          # mutable/compact.py before any artifact write
     "manifest.swap",          # mutable/manifest.py between durability and rename
+    "compact.pin",            # mutable/maintenance.py snapshot pin (lock held)
+    "compact.replay",         # mutable/maintenance.py before catch-up replay
+    "compact.flip",           # mutable/maintenance.py after replay, pre-swap
+    "compact.worker",         # mutable/maintenance.py worker loop (thread death)
 )
 
 

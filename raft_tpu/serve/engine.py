@@ -107,6 +107,12 @@ class _Registration:
     #: ("auto" | "ring" | "gather"; see parallel/sharded_ann.py)
     merge_mode: str = "auto"
     search_kwargs: Dict[str, object] = dataclasses.field(default_factory=dict)
+    #: background maintenance worker for mutable registrations (None
+    #: when auto-compaction is not armed)
+    compactor: object = None
+    #: generation of the last dispatched batch (-1 before the first) —
+    #: crossing a flip bumps the ``serve.generation_flips`` counter
+    last_generation: int = -1
 
 
 class ServingEngine:
@@ -127,6 +133,7 @@ class ServingEngine:
         cache_capacity: int = 64,
         clock: Optional[Callable[[], float]] = None,
         slow_shard_s: Optional[float] = 0.25,
+        maintenance_interval_ms: float = 10.0,
     ):
         self.max_batch = int(max_batch)
         self.batcher = MicroBatcher(
@@ -139,6 +146,9 @@ class ServingEngine:
         #: a health probe slower than this marks the shard unhealthy —
         #: serve degraded coverage now rather than a timeout later
         self.slow_shard_s = slow_shard_s
+        #: floor between maintenance ticks driven from :meth:`step`
+        self.maintenance_interval_ms = float(maintenance_interval_ms)
+        self._last_maint = -float("inf")
         self._indexes: Dict[str, _Registration] = {}
 
     # -- registration ------------------------------------------------------
@@ -195,6 +205,8 @@ class ServingEngine:
         mutable,
         *,
         params=None,
+        policy=None,
+        compactor=None,
         **search_kwargs,
     ) -> None:
         """Register a :class:`raft_tpu.mutable.MutableIndex`.
@@ -207,7 +219,25 @@ class ServingEngine:
         snapshot's generation joins the :class:`ProgramKey`, retiring
         stale programs through the LRU and bounding distinct programs
         to ``generations × (log2(max_batch)+1)`` per configuration.
+
+        ``policy`` (a :class:`raft_tpu.mutable.CompactionPolicy`) arms
+        auto-compaction: the engine starts a background
+        :class:`~raft_tpu.mutable.Compactor` for the index and drives
+        its watchdog/trigger tick from :meth:`step`, so a churning
+        index rebuilds itself off-thread while this engine keeps
+        serving snapshots. Pass a pre-built ``compactor`` instead to
+        control retry policy, seed, or resources; :meth:`shutdown`
+        stops engine-owned workers either way.
         """
+        old = self._indexes.get(index_id)
+        if old is not None and old.compactor is not None:
+            old.compactor.stop()
+        if compactor is None and policy is not None:
+            from raft_tpu.mutable.maintenance import Compactor
+
+            compactor = Compactor(mutable, policy=policy, name=index_id)
+        if compactor is not None:
+            compactor.start()
         self._indexes[index_id] = _Registration(
             index_id=index_id,
             algo="mutable",
@@ -215,6 +245,7 @@ class ServingEngine:
             params=params,
             mode="snapshot",
             search_kwargs=dict(search_kwargs),
+            compactor=compactor,
         )
 
     def registered(self) -> List[str]:
@@ -293,6 +324,9 @@ class ServingEngine:
         Returns the number of requests completed (including deadline
         rejections)."""
         now = self.batcher.now()
+        if now - self._last_maint >= self.maintenance_interval_ms / 1e3:
+            self._last_maint = now
+            self.maintenance_tick()
         if not self.batcher.ready(now) and not (force and self.batcher.depth_requests()):
             return 0
         batch, expired = self.batcher.next_batch(now)
@@ -320,6 +354,25 @@ class ServingEngine:
 
     def queue_depth(self) -> int:
         return self.batcher.depth_rows()
+
+    # -- maintenance -------------------------------------------------------
+
+    def maintenance_tick(self) -> None:
+        """One watchdog + auto-compaction pass over every registration
+        that carries a :class:`~raft_tpu.mutable.Compactor`. Driven
+        from :meth:`step` (rate-limited by ``maintenance_interval_ms``)
+        so serving loops get background maintenance for free; callable
+        directly by deployments with their own schedulers."""
+        for reg in list(self._indexes.values()):
+            if reg.compactor is not None:
+                reg.compactor.tick()
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Stop every engine-owned background compactor. Queued
+        requests stay queued — this only halts maintenance threads."""
+        for reg in self._indexes.values():
+            if reg.compactor is not None:
+                reg.compactor.stop(wait=wait)
 
     # -- precompile --------------------------------------------------------
 
@@ -445,6 +498,12 @@ class ServingEngine:
         # the same immutable view, and writers never race the dispatch
         snap = reg.index.snapshot() if reg.algo == "mutable" else None
         generation = snap.generation if snap is not None else 0
+        if snap is not None:
+            # a batch that crosses a background flip lands wholly on one
+            # side of it (this snapshot); count the crossing
+            if reg.last_generation >= 0 and generation != reg.last_generation:
+                obs.inc("serve.generation_flips", index_id=reg.index_id)
+            reg.last_generation = generation
         key = ProgramKey(
             reg.index_id, reg.algo, bucket, k, params_key(reg.params), generation
         )

@@ -1,0 +1,14 @@
+"""Seeded violation: a full index rebuild dispatched while holding the
+writer lock — every insert, delete, and fresh snapshot queues behind
+the entire build, so the serving p99 becomes the rebuild duration."""
+import threading
+
+_LOCK = threading.Lock()
+_INDEX = None
+
+
+def compact_inline(build, rows):
+    global _INDEX
+    with _LOCK:
+        _INDEX = build(rows)  # LINT-HERE
+    return _INDEX

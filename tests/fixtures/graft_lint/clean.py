@@ -5,7 +5,8 @@ Covers the negative space of every rule: static-arg branches,
 trace-time shape checks, numpy on static values, explicit dtypes,
 module-scope jit, synced wall-clock timing around jitted calls,
 aligned tiles within budget, a *derived* (not hard-coded) chunk
-budget, except handlers that actually handle, and bounded work queues.
+budget, except handlers that actually handle, bounded work queues, and
+rebuilds that run off-lock.
 """
 import collections
 import functools
@@ -94,6 +95,18 @@ def close_quietly(stream, fallback):
     except Exception:
         raise
     return stream
+
+
+def compact_off_lock(build, rows, lock):
+    # blocking-under-lock negative space: pin under the lock, run the
+    # rebuild outside it, re-enter briefly for the pointer flip — the
+    # background-compaction shape the rule exists to push toward
+    with lock:
+        pinned = list(rows)
+    index = build(pinned)  # off-lock: writers and searchers proceed
+    with lock:
+        published = index
+    return published
 
 
 def _copy_kernel(x_ref, o_ref, acc_ref):

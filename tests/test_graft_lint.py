@@ -34,6 +34,7 @@ BAD = {
     "bad_gather_merge.py": "gather-merge",
     "bad_unbounded_queue.py": "unbounded-queue",
     "bad_non_atomic_write.py": "non-atomic-write",
+    "bad_blocking_under_lock.py": "blocking-under-lock",
 }
 
 

@@ -8,19 +8,33 @@ for each fault seam (``wal.append`` pre/post, ``compact.merge``,
 at the seam, reopen the directory cold, and require the recovered
 search state to equal either the pre-mutation or the post-mutation
 state — bit-for-bit, never a mix.
+
+``TestBackgroundCompaction`` extends that gate to the maintenance
+path (pin → rebuild off-lock → catch-up + flip): kills at the new
+seams (``compact.pin``, ``compact.replay``, ``compact.flip``, plus
+worker-thread death at ``compact.worker``) with mutations arriving
+*mid-rebuild* must recover exactly the pre-flip state including those
+mutations, and a completed flip must equal a fresh rebuild over the
+final live rows.
 """
 import dataclasses
 import os
+import threading
+import time
 
 import numpy as np
 import pytest
 
+from raft_tpu import obs
 from raft_tpu.core.errors import CorruptIndexError, LogicError
 from raft_tpu.core import serialize as ser
 from raft_tpu.mutable import (
+    CompactionPolicy,
+    Compactor,
     MutableIndex,
     WalRecord,
     WriteAheadLog,
+    compact_background,
     replay,
     segment_paths,
 )
@@ -419,6 +433,311 @@ class TestCrashChaos:
             assert m2.compact() == 2
         finally:
             m2.close()
+
+
+# -- background compaction: serve through rebuilds, never under them --------
+
+
+class TestBackgroundCompaction:
+    """The maintenance path's acceptance gate: kills at each new seam
+    with insert/delete/upsert arriving mid-rebuild recover exactly the
+    pre-flip state *including* those mutations (never a hybrid), a
+    completed flip equals a fresh rebuild over the final live rows, a
+    dead worker is restarted without losing its request, and transient
+    faults are retried (counted) rather than surfaced."""
+
+    # the rotated variant forces the catch-up replay to read mid-rebuild
+    # records across a WAL segment rotation, not just the active tail
+    @pytest.fixture(params=[None, 600], ids=["wal-single", "wal-rotated"])
+    def seeded(self, rng, tmp_path, request):
+        d = str(tmp_path / "idx")
+        mut = MutableIndex.open(d, "brute_force", DIM, max_wal_bytes=request.param)
+        self.data = _rows(rng, 64)
+        self.ids = mut.insert(self.data)
+        mut.compact()  # main segment populated, small live delta
+        self.extra = mut.insert(_rows(rng, 8))
+        self.queries = _rows(rng, 4)
+        return d, mut
+
+    @pytest.fixture
+    def obs_reg(self):
+        reg = obs.registry()
+        reg.reset()
+        obs.enable()
+        yield reg
+        obs.disable()
+        reg.reset()
+
+    def _mid_mutations(self, rng, mut):
+        """Mutations applied *mid-rebuild* (between the pin and the
+        catch-up replay) — the backlog the flip must carry over."""
+        up_rows = _rows(rng, 3)  # pinned: the same rows on every call
+        return {
+            "insert": lambda: mut.insert(self.data[:3] + 0.25),
+            "delete": lambda: mut.delete(
+                np.concatenate([self.ids[:5], self.extra[:2]])
+            ),
+            "upsert": lambda: mut.upsert(
+                np.array([int(self.ids[1]), int(self.extra[0]), 999]), up_rows
+            ),
+        }
+
+    # -- freshness: the flip equals a fresh rebuild --------------------------
+
+    @pytest.mark.parametrize("op", ["insert", "delete", "upsert", "mixed"])
+    def test_flip_equals_fresh_rebuild_over_final_rows(self, rng, seeded, op):
+        d, mut = seeded
+        mid = self._mid_mutations(rng, mut)
+        names = ["insert", "delete", "upsert"] if op == "mixed" else [op]
+        ran = []
+
+        def hook():
+            for name in names:
+                mid[name]()
+            ran.append(True)
+
+        new_gen = compact_background(mut, _mid_rebuild=hook)
+        assert ran and new_gen == mut.generation == 2
+        got = _state(mut, self.queries)
+        # a fresh index over the final live rows (pinned + replayed, in
+        # the index's stable order) must agree: same neighbors, same
+        # distances up to the delta-vs-main evaluation route
+        live_ids, live_vecs = mut.live_rows()
+        fresh = MutableIndex("brute_force", DIM)
+        fresh.insert(live_vecs, ids=live_ids)
+        want = _state(fresh, self.queries)
+        assert np.array_equal(got[1], want[1])
+        np.testing.assert_allclose(got[0], want[0], rtol=1e-5, atol=1e-6)
+        # durable: a cold reopen sees the flipped state…
+        mut.close()
+        assert _same(_state(d, self.queries), got)
+        # …and compacting both sides folds identical rows in identical
+        # order through the same builder — bit-for-bit equal
+        m2 = MutableIndex.open(d, "brute_force", DIM)
+        try:
+            m2.compact()
+            fresh.compact()
+            d1, i1 = m2.search(self.queries, 5)
+            d2, i2 = fresh.search(self.queries, 5)
+            np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+            np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+        finally:
+            m2.close()
+
+    # -- chaos matrix: kill at each new seam × each mutation kind ------------
+
+    @pytest.mark.parametrize("op", ["insert", "delete", "upsert"])
+    @pytest.mark.parametrize(
+        "seam", ["compact.pin", "compact.replay", "compact.flip", "manifest.swap"]
+    )
+    def test_kill_at_seam_recovers_pre_flip_state(self, rng, seeded, seam, op):
+        d, mut = seeded
+        mid = self._mid_mutations(rng, mut)[op]
+        ran = []
+
+        def hook():
+            mid()
+            ran.append(True)
+
+        gen_before = mut.generation
+        with faults.injected(seam, Kill("die")):
+            with pytest.raises(Kill):
+                compact_background(mut, _mid_rebuild=hook)
+        # a pin-seam kill dies before the rebuild starts, so the
+        # mid-rebuild mutation never ran; every later seam saw it
+        assert bool(ran) == (seam != "compact.pin")
+        assert mut.generation == gen_before, "failed flip must not change generations"
+        # the live object still serves the pre-flip state (old main +
+        # delta, including the mid-rebuild mutation when it ran); cold
+        # recovery must reproduce exactly that — never a hybrid
+        expected = _state(mut, self.queries)
+        mut.close()
+        got = _state(d, self.queries)
+        assert _same(got, expected), (
+            f"kill at {seam} with mid-rebuild {op}: cold recovery diverged "
+            "from the pre-flip state"
+        )
+        # the retried compaction reclaims the same generation number
+        # (stale catch-up WAL segments from the dead attempt are cleared)
+        m2 = MutableIndex.open(d, "brute_force", DIM)
+        try:
+            assert m2.generation == gen_before
+            assert m2.compact() == gen_before + 1
+            assert _same(_state(m2, self.queries), expected)
+        finally:
+            m2.close()
+
+    # -- writers proceed while the rebuild runs ------------------------------
+
+    def test_writers_not_blocked_during_rebuild(self, rng, seeded):
+        d, mut = seeded
+        comp = Compactor(mut, poll_interval_s=0.002)
+        comp.start()
+        probe = _rows(rng, 1)
+        try:
+            # a 0.5 s latency at compact.merge stretches phase 2 (the
+            # off-lock rebuild) long enough to write into it
+            with faults.injected("compact.merge", latency_s=0.5):
+                assert comp.request()
+                deadline = time.monotonic() + 5.0
+                while mut._capture is None and time.monotonic() < deadline:
+                    time.sleep(0.001)
+                assert mut._capture is not None, "worker never pinned"
+                t0 = time.monotonic()
+                new_id = mut.insert(probe)  # lands mid-rebuild
+                dt = time.monotonic() - t0
+                assert dt < 0.25, f"writer blocked {dt:.3f}s behind the rebuild"
+                assert comp.wait_idle(timeout_s=30.0)
+        finally:
+            comp.stop()
+        assert comp.completed == 1 and comp.failed == 0
+        assert mut.generation == 2
+        # the mid-rebuild insert survived the flip via the catch-up replay
+        dd, ii = mut.search(probe, 1)
+        assert ii[0, 0] == new_id[0] and dd[0, 0] < 1e-4
+        mut.close()
+
+    # -- worker death: the watchdog restarts, the request survives -----------
+
+    def test_worker_death_restarted_without_losing_request(self, rng, seeded):
+        d, mut = seeded
+        comp = Compactor(mut, poll_interval_s=0.002)
+        # the injected death escapes the worker loop by design; silence
+        # the default excepthook so the expected traceback stays out of
+        # the test log
+        old_hook = threading.excepthook
+        threading.excepthook = lambda args: None
+        try:
+            comp.start()
+            with faults.injected(
+                "compact.worker", Kill("die"), trigger="first_n", first_n=1
+            ):
+                assert comp.request()
+                assert comp.wait_idle(timeout_s=30.0)
+        finally:
+            threading.excepthook = old_hook
+            comp.stop()
+        assert comp.worker_restarts == 1
+        assert comp.completed == 1 and comp.failed == 0
+        assert mut.generation == 2
+        mut.close()
+
+    # -- retries: transient faults recover, terminal ones are reported -------
+
+    def test_transient_fault_retried_in_background(self, rng, seeded, obs_reg):
+        d, mut = seeded
+        comp = Compactor(mut, poll_interval_s=0.002)
+        comp.start()
+        try:
+            with faults.injected(
+                "compact.merge", Kill("flaky"), trigger="first_n", first_n=1
+            ):
+                assert comp.request()
+                assert comp.wait_idle(timeout_s=30.0)
+        finally:
+            comp.stop()
+        assert comp.completed == 1 and comp.failed == 0
+        assert comp.last_error is None and mut.generation == 2
+        counters = obs_reg.as_dict()["counters"]
+        retried = [
+            v for k, v in counters.items()
+            if k.startswith("mutable.compact.retries") and 'mode="background"' in k
+        ]
+        assert sum(retried) == 1
+        mut.close()
+
+    def test_sync_compact_retries_through_seeded_backoff(self, rng, seeded, obs_reg):
+        d, mut = seeded
+        with faults.injected(
+            "compact.merge", Kill("flaky"), trigger="first_n", first_n=1
+        ):
+            assert mut.compact() == 2
+        counters = obs_reg.as_dict()["counters"]
+        retried = [
+            v for k, v in counters.items()
+            if k.startswith("mutable.compact.retries") and 'mode="sync"' in k
+        ]
+        assert sum(retried) == 1
+        mut.close()
+
+    def test_terminal_failure_reported_then_recovers(self, rng, seeded, obs_reg):
+        d, mut = seeded
+        comp = Compactor(mut, poll_interval_s=0.002)
+        comp.start()
+        try:
+            with faults.injected("compact.flip", Kill("die")):
+                assert comp.request()
+                assert comp.wait_idle(timeout_s=30.0)
+            # every attempt failed: reported (typed, counted), index
+            # still live and serving the old generation
+            assert comp.failed == 1 and isinstance(comp.last_error, Kill)
+            assert mut.generation == 1
+            before = _state(mut, self.queries)
+            # the fault gone, the same worker completes the next request
+            assert comp.request()
+            assert comp.wait_idle(timeout_s=30.0)
+        finally:
+            comp.stop()
+        assert comp.completed == 1 and comp.last_error is None
+        assert mut.generation == 2
+        assert _same(_state(mut, self.queries), before)
+        counters = obs_reg.as_dict()["counters"]
+        failed = [
+            v for k, v in counters.items()
+            if k.startswith("mutable.compact.failed") and 'error="Kill"' in k
+        ]
+        assert sum(failed) == 1
+        mut.close()
+
+    # -- auto-compaction policy ----------------------------------------------
+
+    def test_policy_reason_triggers(self, rng, seeded):
+        d, mut = seeded  # 8 live delta rows, a durable WAL, no tombstones
+        assert CompactionPolicy().reason(mut) is None
+        assert CompactionPolicy(delta_rows=9).reason(mut) is None
+        assert CompactionPolicy(delta_rows=8).reason(mut) == "delta_rows"
+        # a fraction threshold never trips on a tombstone-free index…
+        assert CompactionPolicy(tombstone_fraction=0.0).reason(mut) is None
+        mut.delete(self.ids[:8])
+        # …and fires once deletes accumulate past it
+        assert (
+            CompactionPolicy(tombstone_fraction=0.05).reason(mut)
+            == "tombstone_fraction"
+        )
+        assert CompactionPolicy(wal_bytes=1).reason(mut) == "wal_bytes"
+        assert CompactionPolicy(wal_bytes=10**15).reason(mut) is None
+        mut.close()
+        # wal_bytes never trips on an in-memory (WAL-less) index
+        mem = MutableIndex("brute_force", DIM)
+        mem.insert(_rows(rng, 4))
+        assert CompactionPolicy(wal_bytes=1).reason(mem) is None
+
+    def test_tick_policy_trigger_and_min_interval(self, rng, seeded, obs_reg):
+        d, mut = seeded
+        clk = [0.0]
+        comp = Compactor(
+            mut,
+            policy=CompactionPolicy(delta_rows=4, min_interval_s=100.0),
+            poll_interval_s=0.002,
+            clock=lambda: clk[0],
+        )
+        comp.start()
+        try:
+            assert comp.tick() == "delta_rows"  # 8 delta rows >= 4
+            assert comp.wait_idle(timeout_s=30.0)
+            assert comp.completed == 1 and mut.generation == 2
+            mut.insert(_rows(rng, 6))  # re-trip the trigger…
+            assert comp.tick() is None  # …rate-limited by min_interval_s
+            clk[0] += 101.0
+            assert comp.tick() == "delta_rows"
+            assert comp.wait_idle(timeout_s=30.0)
+        finally:
+            comp.stop()
+        assert comp.completed == 2 and mut.generation == 3
+        gauges = obs_reg.as_dict()["gauges"]
+        assert any(k.startswith("mutable.compact.backlog") for k in gauges)
+        assert any(k.startswith("mutable.maintenance.heartbeat") for k in gauges)
+        mut.close()
 
 
 # -- freshness: mutable search vs fresh rebuild -----------------------------

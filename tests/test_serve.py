@@ -19,6 +19,7 @@ from raft_tpu.bench.loadgen import (
     run_open_loop,
 )
 from raft_tpu.core.errors import RaftError, ShardFailure
+from raft_tpu.mutable import CompactionPolicy, MutableIndex, compact_background
 from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
 from raft_tpu.parallel import make_mesh
 from raft_tpu.robust import faults
@@ -549,6 +550,88 @@ class TestServeChaos:
         assert any(k.startswith("serve.batch_rows") for k in hists)
         spans = [s2["name"] for s2 in serve_obs.spans()]
         assert "serve.dispatch" in spans
+
+
+# -- background maintenance: generation flips under serving ------------------
+
+
+class TestMaintenanceFlip:
+    DIM = 16
+
+    def _mutable(self, rng, n=64):
+        mut = MutableIndex("brute_force", self.DIM)
+        data = rng.standard_normal((n, self.DIM)).astype(np.float32)
+        ids = mut.insert(data)
+        mut.compact()
+        return mut, data, ids
+
+    def test_snapshot_isolation_across_flip(self, rng, serve_obs):
+        """A batch dispatched before a background flip lands wholly on
+        the old generation, the next wholly on the new one — and the
+        crossing is counted exactly once."""
+        mut, data, ids = self._mutable(rng)
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register_mutable("live", mut)
+        pre = eng.submit("live", data[:2], k=3)
+        eng.run_until_idle()
+        # flip in the background with a delete arriving mid-rebuild
+        new_gen = compact_background(mut, _mid_rebuild=lambda: mut.delete(ids[:1]))
+        post = eng.submit("live", data[:2], k=3)
+        eng.run_until_idle()
+        assert pre.result().generation == new_gen - 1
+        assert post.result().generation == new_gen
+        # the pre-flip batch saw row 0; the post-flip batch sees the
+        # mid-rebuild delete carried over by the catch-up replay
+        assert pre.result().indices[0, 0] == ids[0]
+        assert post.result().indices[0, 0] != ids[0]
+        counters = serve_obs.as_dict()["counters"]
+        flips = [v for k, v in counters.items()
+                 if k.startswith("serve.generation_flips")]
+        assert sum(flips) == 1
+
+    def test_background_flips_bound_recompiles(self, rng):
+        """Background flips retire programs exactly like synchronous
+        compaction: distinct programs stay <= generations x buckets."""
+        mut, data, _ids = self._mutable(rng, n=128)
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register_mutable("live", mut)
+        n_buckets = len(bucket_sizes(8))
+        generations = 3
+        for _ in range(generations):
+            for m in (1, 3, 5, 8):
+                fut = eng.submit(
+                    "live", rng.standard_normal((m, self.DIM)).astype(np.float32),
+                    k=5,
+                )
+                eng.run_until_idle()
+                assert fut.result().generation == mut.generation
+            mut.insert(rng.standard_normal((4, self.DIM)).astype(np.float32))
+            compact_background(mut)
+        stats = eng.cache.stats()
+        assert stats.distinct_programs <= (generations + 1) * n_buckets, stats
+
+    def test_engine_policy_auto_compacts_and_shutdown(self, rng):
+        """register_mutable(policy=...) arms an engine-owned Compactor;
+        the step loop's maintenance tick trips the trigger, the index
+        compacts itself while serving continues, and shutdown() stops
+        the worker."""
+        mut, data, _ids = self._mutable(rng)
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0,
+                            maintenance_interval_ms=0.0)
+        eng.register_mutable("live", mut, policy=CompactionPolicy(delta_rows=4))
+        comp = eng._indexes["live"].compactor
+        assert comp is not None and comp.running
+        mut.insert(rng.standard_normal((6, self.DIM)).astype(np.float32))
+        fut = eng.submit("live", data[:2], k=3)
+        eng.run_until_idle()  # step() drives the maintenance tick
+        assert fut.result().indices.shape == (2, 3)
+        assert comp.wait_idle(timeout_s=30.0)
+        assert comp.completed >= 1 and mut.generation == 2
+        post = eng.submit("live", data[:2], k=3)
+        eng.run_until_idle()
+        assert post.result().generation == 2
+        eng.shutdown()
+        assert not comp.running
 
 
 # -- load generation ---------------------------------------------------------

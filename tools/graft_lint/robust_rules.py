@@ -24,6 +24,20 @@ defeats all of it is the silently swallowed exception:
   flagged; transient debug/scratch output gets a rationale'd
   ``# graft-lint: ignore[non-atomic-write]``.
 
+* ``blocking-under-lock`` — an index build, artifact write, or device
+  sync dispatched while a ``threading.Lock``/mutex context is held.
+  Every writer and searcher contending on that lock waits out the
+  whole operation — the p99 becomes the rebuild time (the exact bug
+  background compaction removes: pin under the lock, rebuild outside
+  it, re-enter briefly for the flip). The check is lexical: it flags
+  known-blocking call names (``build``/``fit``/``save_path``/
+  ``swap``/``block_until_ready``/…) in the body of a ``with`` whose
+  context expression names a lock, skipping nested ``def``/``lambda``
+  bodies (deferred, not executed under the lock). Deliberately
+  blocking sections — a documented foreground mode, a flip that ends
+  in one rename — carry a rationale'd
+  ``# graft-lint: ignore[blocking-under-lock]``.
+
 * ``unbounded-queue`` — a work-queue construction with no bound:
   ``queue.Queue()`` / ``LifoQueue()`` / ``PriorityQueue()`` without a
   positive ``maxsize``, ``queue.SimpleQueue()`` (unboundable by
@@ -227,4 +241,94 @@ class NonAtomicWriteChecker(Checker):
             )
 
 
-CHECKERS = [SilentExceptChecker(), UnboundedQueueChecker(), NonAtomicWriteChecker()]
+#: substrings of a ``with`` context-expression name that mark it as a
+#: lock acquisition (``self._lock``, ``mut._compact_mutex``, …)
+_LOCK_HINTS = ("lock", "mutex")
+
+#: call names that block for corpus-proportional (build/save) or
+#: device-roundtrip time — too long for a writer-contended critical
+#: section
+_BLOCKING_NAMES = frozenset(
+    {
+        # index builds / model fits
+        "build", "rebuild", "fit", "_build_main",
+        # artifact writes and durability loops
+        "atomic_write", "save_path", "save_stream", "_save_rows",
+        "_save_main", "_write_generation", "fsync",
+        # the manifest flip and its wrapper
+        "swap", "_publish",
+        # device synchronization / transfer
+        "block_until_ready", "device_put",
+    }
+)
+
+
+def _last_component(expr):
+    """The rightmost name of an expression: ``a.b.c()`` -> "c",
+    ``lock`` -> "lock"; None for anything unnameable."""
+    while isinstance(expr, ast.Call):
+        expr = expr.func
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_lock_expr(expr) -> bool:
+    name = _last_component(expr)
+    return name is not None and any(h in name.lower() for h in _LOCK_HINTS)
+
+
+def _walk_executed(stmts):
+    """Walk statements without descending into nested def/lambda bodies
+    — deferred code does not run while the lock is held."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class BlockingUnderLockChecker(Checker):
+    rule = "blocking-under-lock"
+    doc = (
+        "index build / artifact write / device sync inside a held "
+        "threading lock — writers and searchers queue behind the whole "
+        "operation; pin under the lock, do the work outside, re-enter "
+        "for the flip"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        flagged = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(_is_lock_expr(item.context_expr) for item in node.items):
+                continue
+            for child in _walk_executed(node.body):
+                if not isinstance(child, ast.Call) or id(child) in flagged:
+                    continue
+                name = _last_component(child.func)
+                if name in _BLOCKING_NAMES:
+                    flagged.add(id(child))
+                    yield self.violation(
+                        module, child,
+                        f"{name}() runs while a lock is held — writers and "
+                        "searchers queue behind it for the whole call; "
+                        "pin state under the lock, run the blocking work "
+                        "outside it, and re-enter only for the pointer "
+                        "flip (see raft_tpu.mutable.maintenance), or "
+                        "suppress with a rationale where blocking is the "
+                        "documented contract",
+                    )
+
+
+CHECKERS = [
+    SilentExceptChecker(),
+    UnboundedQueueChecker(),
+    NonAtomicWriteChecker(),
+    BlockingUnderLockChecker(),
+]

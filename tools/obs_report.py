@@ -86,6 +86,14 @@ _DISPATCH_PREFIXES = (
     "mutable.delta.",
 )
 
+#: prefixes routed to the "robustness & mutability" health table
+_HEALTH_PREFIXES = ("robust.", "mutable.", "faults.")
+
+#: serve-side metrics that belong to the health picture, not the
+#: generic serving tables (a generation flip is a mutability event the
+#: operator correlates with compactions, not with QPS)
+_HEALTH_EXTRAS = ("serve.generation_flips",)
+
 
 def _key(rec: Dict[str, Any]) -> str:
     labels = rec.get("labels") or {}
@@ -186,27 +194,28 @@ def render_report(*paths: str, top: int = 10) -> str:
                         + _table(dispatch_rows, ["counter", "value"]))
     # robustness + mutability get their own table: fault fires, retries,
     # fallbacks, WAL traffic (records/bytes/rotations), tombstone
-    # fraction, generations — the health picture an operator scans
-    # first, pulled out of the generic tables so it cannot drown in
-    # per-algo serving counters
+    # fraction, generations, compaction backlog/heartbeat and serving
+    # generation flips — the health picture an operator scans first,
+    # pulled out of the generic tables so it cannot drown in per-algo
+    # serving counters
     health_rows = [
         [k, kind, f"{v:g}"]
         for kind, table in (("counter", counters), ("gauge", gauges))
         for k, v in sorted(table.items())
-        if k.startswith(("robust.", "mutable.", "faults."))
+        if (k.startswith(_HEALTH_PREFIXES) or k.startswith(_HEALTH_EXTRAS))
         and not k.startswith(_DISPATCH_PREFIXES)
     ]
     if health_rows:
         sections.append("## robustness & mutability\n"
                         + _table(health_rows, ["metric", "kind", "value"]))
     plain = {k: v for k, v in counters.items()
-             if not k.startswith(("robust.", "mutable.", "faults.")
+             if not k.startswith(_HEALTH_PREFIXES + _HEALTH_EXTRAS
                                  + _DISPATCH_PREFIXES)}
     if plain:
         rows = [[k, f"{v:g}"] for k, v in sorted(plain.items())]
         sections.append("## counters\n" + _table(rows, ["counter", "value"]))
     plain_g = {k: v for k, v in gauges.items()
-               if not k.startswith(("robust.", "mutable.", "faults."))}
+               if not k.startswith(_HEALTH_PREFIXES + _HEALTH_EXTRAS)}
     if plain_g:
         rows = [[k, f"{v:g}"] for k, v in sorted(plain_g.items())]
         sections.append("## gauges\n" + _table(rows, ["gauge", "value"]))
