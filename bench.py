@@ -983,7 +983,7 @@ def _bench_main():
                     lambda qs=qs, sp_lat=sp_lat: cagra.search(cidx, qs, K, sp_lat),
                     nrep=2,
                 )
-                row_rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt[:bq]))
+                row_rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt[:bq]))  # graft-lint: ignore[sync-transfer-in-loop] — post-_timed materialization for recall; timing already closed
                 lat_row = {
                     "config": f"batch={bq} itopk={sp_lat.itopk_size} w={sp_lat.search_width}",
                     "qps": round(bq / dt, 1),
@@ -1007,7 +1007,7 @@ def _bench_main():
                         ),
                         nrep=2,
                     )
-                    row_rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt[:bq]))
+                    row_rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt[:bq]))  # graft-lint: ignore[sync-transfer-in-loop] — post-_timed materialization for recall; timing already closed
                     lat_row = {
                         "config": (
                             f"batch={bq} itopk={sp_lat.itopk_size}"
@@ -1103,6 +1103,148 @@ def _bench_main():
         except Exception as e:  # noqa: BLE001
             phase_errors["serve"] = f"{type(e).__name__}: {e}"[:200]
             print(f"# serve failed: {phase_errors['serve']}", flush=True)
+
+    # ---- tiered: HBM-resident codes, host-resident raw vectors -----------
+    # The out-of-core serving claim measured end to end: PQ codes and
+    # centroids stay device-resident while the raw f32 corpus — sized by
+    # construction at >=4x the scan-resident HBM budget — lives in host
+    # memory and streams up per micro-batch, hidden behind the next
+    # batch's scan (docs/tiered.md). The rows are full-corpus operating
+    # points against the same ground truth, so "tiered" competes in the
+    # Pareto summary, and the in-bench asserts pin the two claims: ids
+    # bit-identical to the all-resident refine path, and p99 within 2x
+    # of all-resident at recall >= 0.95.
+    tiered_summary = {}
+    if over_budget(0.93):
+        print("# tiered skipped: time budget", flush=True)
+    elif pidx is None:
+        print("# tiered skipped: no ivf_pq index", flush=True)
+    else:
+        try:
+            from raft_tpu.ops.pallas.hbm_model import residency_for_index
+            from raft_tpu.tiered import HostVectorStore, TieredIndex
+
+            t_res = residency_for_index("bench", "ivf_pq", pidx,
+                                        refine_rows=n_rows)
+            # the tightest budget the scan itself still fits under (the
+            # same 0.9 headroom plan_placement applies), so raw_vectors
+            # are forced to the host tier and the corpus:budget ratio is
+            # as honest as it gets
+            t_budget = int(t_res.required_bytes / 0.9) + (64 << 10)
+            host_np = np.asarray(dataset, np.float32)
+            corpus_x = host_np.nbytes / t_budget
+            if os.environ.get("RAFT_TPU_BENCH_SMOKE"):
+                # smoke corpora are too small for the 4x claim — the
+                # 1024 coarse centers alone dominate the budget there
+                # (tests/test_tiered.py pins 4x at a representative
+                # shape); smoke only checks the code path end to end
+                print(f"# tiered           smoke corpus {corpus_x:.1f}x "
+                      f"budget (4x asserted at full scale)", flush=True)
+            else:
+                assert host_np.nbytes >= 4 * t_budget, (
+                    "tiered corpus must exceed 4x the device budget: "
+                    f"{host_np.nbytes} B raw vs {t_budget} B budget "
+                    f"({corpus_x:.1f}x)")
+            t_mb = 128 if os.environ.get("RAFT_TPU_BENCH_SMOKE") else 256
+            t_rr = 12  # measured ~0.96 recall at npr=30 (ivf_pq rows above)
+            sp_scan = ivf_pq.IvfPqSearchParams(
+                n_probes=30, fused_probe_factor=32, fused_group=8)
+            sp_res = dataclasses.replace(sp_scan, refine_ratio=t_rr)
+
+            # all-resident baseline: same scan, same refine core, raw
+            # corpus in device memory — the comparison row AND the
+            # bit-parity reference
+            dt_res, (v, i_res) = _timed(
+                lambda: ivf_pq.search(pidx, queries, K, sp_res, mode="fused",
+                                      dataset=dataset, query_batch=t_mb),
+                nrep=2, label="tiered_resident",
+            )
+            record("ivf_pq", f"fused nib32 npr=30 refine={t_rr}x qb={t_mb}",
+                   dt_res, i_res)
+            res_p99 = dt_res.p99 * 1e3
+            ids_res = np.asarray(i_res)
+
+            store = HostVectorStore(host_np)
+            ti = TieredIndex("ivf_pq", pidx, store, refine_ratio=t_rr,
+                             micro_batch=t_mb, search_params=sp_scan)
+
+            def _tiered_timed(overlap, label):
+                # counter deltas around the timed region give the row's
+                # fetch_bytes_per_query and overlap_efficiency columns
+                was_on = obs.is_enabled()
+                if not was_on:
+                    obs.enable()
+                before = obs.registry().as_dict()["counters"]
+                b0 = float(before.get("tiered.fetch.bytes", 0.0))
+                t_nrep, t_inner = 2, 4
+                dt, (v, i) = _timed(
+                    lambda: ti.search(queries, K, mode="fused",
+                                      overlap=overlap),
+                    nrep=t_nrep, inner=t_inner, label=label,
+                )
+                snap = obs.registry().as_dict()
+                fetched = float(snap["counters"].get("tiered.fetch.bytes", 0.0)) - b0
+                eff = float(snap["gauges"].get("tiered.overlap_efficiency", 0.0))
+                if not was_on:
+                    obs.disable()
+                calls = 1 + t_nrep * t_inner  # _timed: warmup + nrep*inner
+                return dt, np.asarray(i), fetched / (calls * nq), eff
+
+            dt_t, ids_t, fpq, eff = _tiered_timed(True, "tiered_overlap")
+            record("tiered", f"host-tier overlap refine={t_rr}x mb={t_mb}",
+                   dt_t, ids_t, fetch_bytes_per_query=round(fpq, 1),
+                   overlap_efficiency=round(eff, 3),
+                   host_corpus_x_budget=round(corpus_x, 1))
+            np.testing.assert_array_equal(
+                ids_t, ids_res,
+                err_msg="tiered ids diverged from the all-resident refine path")
+
+            dt_s, ids_s, fpq_s, _ = _tiered_timed(False, "tiered_serial")
+            record("tiered", f"host-tier serial refine={t_rr}x mb={t_mb}",
+                   dt_s, ids_s, fetch_bytes_per_query=round(fpq_s, 1),
+                   overlap_efficiency=0.0,
+                   host_corpus_x_budget=round(corpus_x, 1))
+            np.testing.assert_array_equal(
+                ids_s, ids_res,
+                err_msg="serial tiered ids diverged from the all-resident path")
+
+            t_p99 = dt_t.p99 * 1e3
+            rec_t = recall(ids_t)
+            if rec_t >= 0.95:
+                # the latency claim, asserted in-bench: tiering the raw
+                # vectors out of HBM must not double tail latency at the
+                # recall-0.95 operating point
+                assert t_p99 <= 2.0 * res_p99, (
+                    f"tiered p99 {t_p99:.2f} ms exceeds 2x the all-resident "
+                    f"p99 {res_p99:.2f} ms at recall {rec_t:.4f}")
+                print(f"# tiered           p99 {t_p99:.2f} ms vs resident "
+                      f"{res_p99:.2f} ms (bound {2.0 * res_p99:.2f}), ids "
+                      f"identical, corpus {corpus_x:.1f}x budget",
+                      flush=True)
+            elif os.environ.get("RAFT_TPU_BENCH_SMOKE"):
+                # smoke corpora are too small for the recall floor; the
+                # parity asserts above already covered correctness
+                print(f"# tiered           latency bound unchecked in smoke "
+                      f"(recall {rec_t:.4f} < 0.95)", flush=True)
+            else:
+                raise AssertionError(
+                    f"tiered operating point must clear recall 0.95, "
+                    f"got {rec_t:.4f}")
+            tiered_summary = {
+                "hbm_budget_bytes": t_budget,
+                "host_corpus_bytes": int(host_np.nbytes),
+                "corpus_x_budget": round(corpus_x, 1),
+                "resident_p99_ms": round(res_p99, 2),
+                "tiered_p99_ms": round(t_p99, 2),
+                "serial_p99_ms": round(dt_s.p99 * 1e3, 2),
+                "fetch_bytes_per_query": round(fpq, 1),
+                "overlap_efficiency": round(eff, 3),
+                "ids_bit_identical": True,
+            }
+            del store, ti, host_np
+        except Exception as e:  # noqa: BLE001
+            phase_errors["tiered"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"# tiered failed: {phase_errors['tiered']}", flush=True)
 
     # ---- mutable churn: sustained insert/delete while serving ------------
     # one mutable ivf_flat index under write pressure: every tick inserts
@@ -1303,7 +1445,7 @@ def _bench_main():
                     )
                     record(f"{name}_{m}", f"nd={n_dev} k={K}", dt, i,
                            wire_bytes_per_query=round(wire[m], 1))
-                    per_mode[m] = (dt, np.asarray(i))
+                    per_mode[m] = (dt, np.asarray(i))  # graft-lint: ignore[sync-transfer-in-loop] — post-_timed materialization for the id-parity check
                 # transport acceptance: identical ids, not just recall
                 np.testing.assert_array_equal(
                     per_mode["ring"][1], per_mode["gather"][1],
@@ -1359,7 +1501,8 @@ def _bench_main():
             _rec.set_context(build_seconds=build_times, efficiency=efficiency,
                              phase_errors=phase_errors, pareto=pareto,
                              kmeans_compare=kmeans_compare,
-                             ring_speedup=ring_speedup)
+                             ring_speedup=ring_speedup,
+                             tiered=tiered_summary)
         except Exception as e:  # noqa: BLE001
             print(f"# artifact context dropped: {e}", flush=True)
 
@@ -1433,6 +1576,7 @@ def _bench_main():
                     "pareto": pareto,
                     "kmeans_compare": kmeans_compare,
                     "ring_speedup": ring_speedup,
+                    "tiered": tiered_summary,
                     "all_results": results,
                     "build_seconds": build_times,
                     "cagra_error": cagra_err,

@@ -145,7 +145,7 @@ def _exact_knn(base: np.ndarray, queries: np.ndarray, k: int, metric: str, batch
     outs = []
     for s in range(0, queries.shape[0], batch):
         _, i = brute_force.search(index, queries[s : s + batch], k)
-        outs.append(np.asarray(i))
+        outs.append(np.asarray(i))  # graft-lint: ignore[sync-transfer-in-loop] — per-batch host copy bounds GT memory; a one-off, not a serving path
     jax.block_until_ready(outs[-1])
     return np.concatenate(outs, axis=0)
 

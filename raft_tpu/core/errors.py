@@ -60,6 +60,23 @@ class CorruptIndexError(RaftError):
         self.actual_crc = actual_crc
 
 
+class HostFetchError(RaftError):
+    """The host-tier vector fetch behind a tiered search failed after
+    exhausting its retries (see :mod:`raft_tpu.tiered`). Carries the
+    batch shape so an operator can correlate with ``tiered.fetch.*``
+    metrics and the ``host.fetch`` fault seam."""
+
+    def __init__(self, msg: str, *, rows: int | None = None, attempts: int | None = None):
+        detail = []
+        if rows is not None:
+            detail.append(f"rows={rows}")
+        if attempts is not None:
+            detail.append(f"attempts={attempts}")
+        super().__init__(f"{msg} [{', '.join(detail)}]" if detail else msg)
+        self.rows = rows
+        self.attempts = attempts
+
+
 def expects(cond: bool, msg: str, *args) -> None:
     """Runtime check macro analog of ``RAFT_EXPECTS(cond, fmt, ...)``."""
     if not cond:

@@ -53,12 +53,21 @@ DELTA_MODES = ("auto", "exact", "fused")
 
 #: The fused delta scan is lossless only while every merge bank holds a
 #: single 128-lane group (see ``ops.pallas.ivf_scan._seg_compress``):
-#: with the ``bank8`` merge that caps the padded delta at 8 * 128 rows,
-#: so routing through the kernel keeps *bitwise* candidate parity with
-#: the exact XLA scan rather than the approximate-top-k semantics the
-#: big fused indexes accept.
+#: with the ``bank8`` merge that caps ONE kernel call at 8 * 128 padded
+#: rows. Past that the delta is tiled into multiple 1024-row banks, each
+#: scanned by its own (identically-shaped, so compiled-once) kernel call
+#: inside the lossless window, and the per-bank top-k lists are k-way
+#: merged on the accelerator by one stable sort — so routing through the
+#: kernel keeps *bitwise* candidate parity with the exact XLA scan at
+#: any banked size, rather than the approximate-top-k semantics the big
+#: fused indexes accept.
 _DELTA_FUSED_MAX_ROWS = 1024
 _DELTA_FUSED_QT = 128
+#: fused-route ceiling in banks: past 32 banks (32k padded rows) the
+#: per-bank launch overhead beats the XLA scan and compaction is overdue
+#: anyway — CompactionPolicy's delta-row trigger should have fired long
+#: before.
+_DELTA_FUSED_MAX_BANKS = 32
 
 #: metrics whose fused-kernel epilogue matches brute-force exact
 #: distances term-for-term (cosine divides by the norm product on the
@@ -124,10 +133,15 @@ def _search_main(algo: str, index, queries, k: int, params, prefilter, dataset, 
 
 
 def _delta_fused_eligible(metric, cap: int, k: int) -> bool:
-    """True when the single-list fused kernel reproduces the exact scan
-    bit-for-bit: a supported metric, the padded delta within the
-    lossless bank-merge window, and k within one extract width."""
-    return metric in _DELTA_FUSED_METRICS and cap <= _DELTA_FUSED_MAX_ROWS and k <= 128
+    """True when the banked fused scan reproduces the exact scan
+    bit-for-bit: a supported metric, the padded delta within the banked
+    window (each 1024-row bank stays inside the lossless bank-merge
+    width), and k within one extract width."""
+    return (
+        metric in _DELTA_FUSED_METRICS
+        and cap <= _DELTA_FUSED_MAX_ROWS * _DELTA_FUSED_MAX_BANKS
+        and k <= 128
+    )
 
 
 def _delta_route(mode: str, metric, cap: int, k: int) -> str:
@@ -142,7 +156,7 @@ def _delta_route(mode: str, metric, cap: int, k: int) -> str:
             eligible,
             "delta_mode='fused' needs an L2/IP metric, a delta of <= %d "
             "(padded) rows and k <= 128",
-            _DELTA_FUSED_MAX_ROWS,
+            _DELTA_FUSED_MAX_ROWS * _DELTA_FUSED_MAX_BANKS,
         )
         return "fused"
     import jax
@@ -152,7 +166,8 @@ def _delta_route(mode: str, metric, cap: int, k: int) -> str:
 
 def _delta_fused_search(metric, delta_bf, delta_live, queries, k: int):
     """Delta scan through the fused Pallas probed-list kernel, treating
-    the padded delta buffer as ONE list that every query tile probes.
+    each 1024-row tile of the padded delta buffer as ONE list that every
+    query tile probes.
 
     Within the eligibility window (:func:`_delta_fused_eligible`) the
     kernel's lane-group compression is a pure reshuffle — no candidate
@@ -162,6 +177,15 @@ def _delta_fused_search(metric, delta_bf, delta_live, queries, k: int):
     rounding (the parity gate in ``tests/test_mutable.py``). Dead and
     padding rows fold into the slot validity the same way the live
     bitset masks the exact scan.
+
+    Past one bank (padded cap > 1024 — always a multiple of 1024, the
+    cap grows by doubling) every bank is scanned by its own kernel call,
+    each inside the lossless window, and the per-bank top-k lists are
+    k-way merged by one stable sort on the kernel-space scores: the
+    epilogue is a per-query monotone map, per-bank lists break ties by
+    ascending slot, and banks concatenate in ascending-slot order — so
+    the merged ids keep the exact scan's lowest-id-wins tie discipline.
+    The bank count is published as the ``mutable.delta.banks`` gauge.
     """
     import jax
 
@@ -183,23 +207,45 @@ def _delta_fused_search(metric, delta_bf, delta_live, queries, k: int):
         else jnp.ones((cap,), bool)
     )
     positions = jnp.arange(cap, dtype=jnp.int32)
-    list_indices = jnp.where(mask, positions, -1)[None, :]
     tile_probes = jnp.zeros((n_qt, 1), jnp.int32)
     probe_valid = jnp.ones((n_qt, 1), jnp.int32)
     norms = delta_bf.norms
-    vals, slots = fused_list_topk(
-        delta_bf.dataset[None].astype(jnp.float32),
-        norms[None] if norms is not None else None,
-        list_indices,
-        qf,
-        tile_probes,
-        probe_valid,
-        k=k,
-        metric=metric,
-        qt=qt,
-        merge="bank8",
-        interpret=jax.default_backend() != "tpu",
-    )
+    interpret = jax.default_backend() != "tpu"
+
+    bank_rows = _DELTA_FUSED_MAX_ROWS
+    n_banks = max(1, (cap + bank_rows - 1) // bank_rows)
+
+    bank_vals, bank_slots = [], []
+    for b in range(n_banks):
+        lo, hi = b * bank_rows, min((b + 1) * bank_rows, cap)
+        list_indices = jnp.where(mask[lo:hi], positions[lo:hi], -1)[None, :]
+        v, s = fused_list_topk(
+            delta_bf.dataset[lo:hi][None].astype(jnp.float32),
+            norms[lo:hi][None] if norms is not None else None,
+            list_indices,
+            qf,
+            tile_probes,
+            probe_valid,
+            k=k,
+            metric=metric,
+            qt=qt,
+            merge="bank8",
+            interpret=interpret,
+        )
+        bank_vals.append(v)
+        # Kernel slots are rows within the data it was handed — lift the
+        # bank's rows back to global delta positions (invalid stays -1).
+        bank_slots.append(jnp.where(s >= 0, s + lo, -1))
+    if obs.is_enabled():
+        obs.set_gauge("mutable.delta.banks", float(n_banks))
+    if n_banks == 1:
+        vals, slots = bank_vals[0], bank_slots[0]
+    else:
+        all_v = jnp.concatenate(bank_vals, axis=1)
+        all_s = jnp.concatenate(bank_slots, axis=1)
+        order = jnp.argsort(all_v, axis=1, stable=True)[:, :k]
+        vals = jnp.take_along_axis(all_v, order, axis=1)
+        slots = jnp.take_along_axis(all_s, order, axis=1)
     idx = jnp.where(slots >= 0, slots, -1)
     if metric == DistanceType.InnerProduct:
         out = -vals

@@ -224,6 +224,8 @@ def search(
     mode: str = "exact",
     recall_target: float = 0.99,
     res: Optional[Resources] = None,
+    dataset=None,
+    refine_ratio: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """k-nearest-neighbor search.
 
@@ -239,8 +241,29 @@ def search(
     ``recall_target``; available for the expanded metrics
     (L2/IP/cosine).
 
+    ``dataset`` + ``refine_ratio > 1`` adds the integrated refine (same
+    contract as ivf_pq/ivf_flat): the scan keeps ``k * refine_ratio``
+    candidates that an exact f32 re-rank against ``dataset`` — a device
+    array or a tiered ``HostVectorStore`` — cuts back to ``k``. The
+    natural pairing is ``mode="approx"`` (or a narrow-dtype index),
+    where the re-rank recovers exactness the scan traded away.
+
     With :mod:`raft_tpu.obs` enabled the call is wrapped in a
     device-synced ``brute_force.search`` span with per-mode counters."""
+    if dataset is not None and refine_ratio > 1:
+        from raft_tpu.neighbors.refine import check_refine_dataset, refine
+
+        check_refine_dataset(dataset, index.size, "brute_force")
+        kk = min(k * refine_ratio, index.size)
+        _, cand = search(
+            index, queries, kk, prefilter=prefilter, query_batch=query_batch,
+            dataset_tile=dataset_tile, mode=mode, recall_target=recall_target, res=res,
+        )
+        with obs.span("brute_force.search.refine", k=k, candidates=int(kk)) as sp:
+            return sp.sync(
+                refine(dataset, queries, cand, k, metric=index.metric,
+                       metric_arg=index.metric_arg)
+            )
     if not obs.is_enabled():
         return _search_dispatch(
             index, queries, k, prefilter, query_batch, dataset_tile, mode, recall_target, res

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import BalancedKMeansParams
 from raft_tpu.core import serialize as ser
@@ -105,6 +106,12 @@ class IvfFlatSearchParams:
     # column-chunk rows (0 = whole DMA block at once)
     fused_extract_every: int = 0
     fused_col_chunk: int = 1024
+    # Exact re-rank depth: search keeps k * refine_ratio candidates and
+    # re-scores them against the raw dataset (refine.refine) when search()
+    # is given one — the escape hatch that recovers exactness when
+    # list_data is stored in a narrow dtype (bf16/int8) or the scan ran
+    # an approximate top-k. 1 = off (the all-resident default).
+    refine_ratio: int = 1
 
 
 @jax.tree_util.register_pytree_node_class
@@ -614,6 +621,7 @@ def search(
     query_batch: int = 1024,
     mode: str = "auto",
     res: Optional[Resources] = None,
+    dataset=None,
     **kwargs,
 ) -> Tuple[jax.Array, jax.Array]:
     """ANN search over probed lists (``ivf_flat::search``,
@@ -638,6 +646,25 @@ def search(
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "bad query shape")
     expects(k >= 1, "k must be >= 1")
+    if dataset is not None and params.refine_ratio > 1:
+        # Integrated refine (same contract as ivf_pq.search): scan for
+        # k * refine_ratio candidates, then exact re-rank against the raw
+        # dataset — a device array or a tiered HostVectorStore.
+        from raft_tpu.neighbors.refine import check_refine_dataset, refine
+
+        check_refine_dataset(dataset, index.size, "ivf_flat")
+        inner = dataclasses.replace(params, refine_ratio=1)
+        kk = min(k * params.refine_ratio, index.size)
+        _, cand = search(
+            index, queries, kk, inner,
+            prefilter=prefilter, query_batch=query_batch, mode=mode, res=res,
+        )
+        if obs.is_enabled():
+            obs.observe("ivf_flat.search.refine_candidates_per_query", float(kk))
+        with obs.span("ivf_flat.search.refine", k=k, candidates=int(kk)) as sp:
+            return sp.sync(
+                refine(dataset, queries, cand, k, metric=resolve_metric(index.metric))
+            )
     if prefilter is not None:
         expects(prefilter.size >= index.size, "prefilter smaller than index")
     n_probes = min(params.n_probes, index.n_lists)

@@ -1614,8 +1614,12 @@ def _search_dispatch(
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "bad query shape")
     expects(k >= 1, "k must be >= 1")
     if dataset is not None and params.refine_ratio > 1:
-        from raft_tpu.neighbors.refine import refine
+        from raft_tpu.neighbors.refine import check_refine_dataset, refine
 
+        # Validate the dataset/index agreement BEFORE the scan runs: a
+        # short dataset used to surface only as an out-of-bounds gather
+        # deep inside refine's jit.
+        check_refine_dataset(dataset, index.size, "ivf_pq")
         inner = dataclasses.replace(params, refine_ratio=1)
         kk = min(k * params.refine_ratio, index.size)
         _, cand = search(

@@ -9,6 +9,18 @@ whole body runs under one ``jit`` so the gather feeds the distance matmul
 and the top-k inside a single device program (eager dispatch per op costs
 several HBM round-trips plus, on tunneled dev chips, ~100 ms of host link
 per hop — measured 3-4x end-to-end on the bench's refine rows).
+
+Two gather tiers share one re-rank core (:func:`_exact_rerank`):
+
+* device-resident ``dataset`` — the gather is ``dataset[ids]`` inside the
+  jit (:func:`_refine_impl`), the original all-in-HBM path;
+* host-resident ``dataset`` (a :class:`raft_tpu.tiered.HostVectorStore`)
+  — the gather is an ``np.take`` on the host, the ``[batch, n_cand, dim]``
+  slab is ``device_put`` and re-ranked by :func:`_refine_gathered_impl`.
+
+Both paths run the identical f32 arithmetic on identical gathered values,
+so tiered results are bit-identical to the all-resident ones (asserted in
+``tests/test_tiered.py``).
 """
 from __future__ import annotations
 
@@ -26,14 +38,40 @@ from raft_tpu.ops.distance import DistanceType, is_min_close, resolve_metric, ro
 from raft_tpu.ops.select_k import select_k, worst_value
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric", "metric_arg"))
-def _refine_impl(
-    dataset, queries, candidates, *, k: int, metric: DistanceType, metric_arg: float
-) -> Tuple[jax.Array, jax.Array]:
-    valid = candidates >= 0
-    safe_ids = jnp.where(valid, candidates, 0)
-    cand_vecs = dataset[safe_ids]  # [nq, n_cand, d]
+def is_host_dataset(dataset) -> bool:
+    """True for host-tier vector stores (duck-typed so this module never
+    imports :mod:`raft_tpu.tiered`, which imports it)."""
+    return getattr(dataset, "is_host_tier", False)
 
+
+def check_refine_dataset(dataset, index_size: int, algo: str = "index") -> None:
+    """Validate a refine ``dataset`` against the index it re-ranks for —
+    *before* any scan runs, so a short dataset fails up front with a
+    typed :class:`~raft_tpu.core.errors.LogicError` naming the index
+    size instead of deep inside the candidate gather."""
+    shape = np.shape(dataset) if not hasattr(dataset, "shape") else tuple(dataset.shape)
+    expects(
+        len(shape) == 2,
+        "%s refine dataset must be [n_rows, dim], got shape %s", algo, shape,
+    )
+    rows = int(shape[0])
+    expects(
+        rows >= index_size,
+        "%s refine dataset has %d rows but the index holds %d vectors — "
+        "every stored id must be gatherable; pass the full build dataset "
+        "(or a HostVectorStore over it)",
+        algo, rows, index_size,
+    )
+
+
+def _exact_rerank(
+    cand_vecs, queries, candidates, valid, *, k: int, metric: DistanceType, metric_arg: float
+) -> Tuple[jax.Array, jax.Array]:
+    """Shared re-rank core: exact per-candidate distances + top-k.
+
+    ``cand_vecs`` [nq, n_cand, d] is the gathered candidate slab —
+    whichever tier produced it, the arithmetic from here on is identical,
+    which is what makes tiered and resident results bit-equal."""
     qf = queries.astype(jnp.float32)
     cf = cand_vecs.astype(jnp.float32)
 
@@ -69,6 +107,30 @@ def _refine_impl(
     return vals, idx
 
 
+@functools.partial(jax.jit, static_argnames=("k", "metric", "metric_arg"))
+def _refine_impl(
+    dataset, queries, candidates, *, k: int, metric: DistanceType, metric_arg: float
+) -> Tuple[jax.Array, jax.Array]:
+    valid = candidates >= 0
+    safe_ids = jnp.where(valid, candidates, 0)
+    cand_vecs = dataset[safe_ids]  # [nq, n_cand, d]
+    return _exact_rerank(
+        cand_vecs, queries, candidates, valid, k=k, metric=metric, metric_arg=metric_arg
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "metric_arg"))
+def _refine_gathered_impl(
+    cand_vecs, queries, candidates, *, k: int, metric: DistanceType, metric_arg: float
+) -> Tuple[jax.Array, jax.Array]:
+    """Re-rank a pre-gathered slab (host-tier fetch): the gather already
+    substituted row 0 for invalid slots exactly like :func:`_refine_impl`."""
+    valid = candidates >= 0
+    return _exact_rerank(
+        cand_vecs, queries, candidates, valid, k=k, metric=metric, metric_arg=metric_arg
+    )
+
+
 def refine(
     dataset,
     queries,
@@ -80,6 +142,10 @@ def refine(
 ) -> Tuple[jax.Array, jax.Array]:
     """Re-rank ``candidates`` [n_queries, n_cand] (i32 ids into ``dataset``,
     -1 = invalid) down to the top ``k`` by exact distance.
+
+    ``dataset`` may be a device array (all-in-HBM gather) or a
+    :class:`raft_tpu.tiered.HostVectorStore` (host-tier ``np.take`` +
+    ``device_put`` slab per batch); results are bit-identical.
 
     ``query_batch``: 0 = auto — cap the gathered [batch, n_cand, dim] f32
     temporary at ~1 GB (CAGRA's graph build refines the WHOLE dataset as
@@ -118,7 +184,9 @@ def _refine_dispatch(
     query_batch: int,
 ) -> Tuple[jax.Array, jax.Array]:
     metric = resolve_metric(metric)
-    dataset = jnp.asarray(dataset)
+    host_tier = is_host_dataset(dataset)
+    if not host_tier:
+        dataset = jnp.asarray(dataset)
     queries = jnp.asarray(queries)
     candidates = jnp.asarray(candidates, jnp.int32)
     expects(candidates.ndim == 2, "candidates must be [n_queries, n_candidates]")
@@ -130,6 +198,15 @@ def _refine_dispatch(
     if query_batch <= 0:
         per_q = max(1, n_cand * dataset.shape[1] * 4)
         query_batch = max(256, (1 << 30) // per_q)
+
+    def one_batch(q, c):
+        if host_tier:
+            slab = dataset.gather(np.asarray(c))
+            return _refine_gathered_impl(
+                slab, q, c, k=k, metric=metric, metric_arg=metric_arg
+            )
+        return _refine_impl(dataset, q, c, k=k, metric=metric, metric_arg=metric_arg)
+
     if nq > query_batch:
         out_v, out_i = [], []
         for s in range(0, nq, query_batch):
@@ -143,9 +220,9 @@ def _refine_dispatch(
                 )
             else:
                 q, c = queries[s : s + cnt], candidates[s : s + cnt]
-            v, i = _refine_impl(dataset, q, c, k=k, metric=metric, metric_arg=metric_arg)
+            v, i = one_batch(q, c)
             out_v.append(v[:cnt])
             out_i.append(i[:cnt])
         return jnp.concatenate(out_v, axis=0), jnp.concatenate(out_i, axis=0)
 
-    return _refine_impl(dataset, queries, candidates, k=k, metric=metric, metric_arg=metric_arg)
+    return one_batch(queries, candidates)

@@ -83,6 +83,7 @@ FAULT_POINTS = (
     "compact.replay",         # mutable/maintenance.py before catch-up replay
     "compact.flip",           # mutable/maintenance.py after replay, pre-swap
     "compact.worker",         # mutable/maintenance.py worker loop (thread death)
+    "host.fetch",             # tiered/store.py host-tier candidate gather
 )
 
 

@@ -67,7 +67,13 @@ _DEFAULT_MODES = {
     "cagra": "auto",
     "sharded_ivf_flat": "sharded",
     "sharded_ivf_pq_lists": "sharded",
+    # pre-built TieredIndex: device scan + host-tier refine gather
+    "tiered": "auto",
 }
+
+#: algos the HBM placement planner knows how to model (and whose refine
+#: dataset can degrade to the host tier)
+_TIERABLE_ALGOS = ("ivf_pq", "ivf_flat", "brute_force")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,8 +140,16 @@ class ServingEngine:
         clock: Optional[Callable[[], float]] = None,
         slow_shard_s: Optional[float] = 0.25,
         maintenance_interval_ms: float = 10.0,
+        hbm_budget_bytes: Optional[int] = None,
     ):
         self.max_batch = int(max_batch)
+        #: device-HBM budget for the placement planner (None = unplanned:
+        #: every registration keeps its dataset wherever the caller put it)
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self._residencies: Dict[str, object] = {}
+        #: the planner's last verdict (an hbm_model.Placement), for
+        #: introspection/tests after registrations
+        self.placement = None
         self.batcher = MicroBatcher(
             max_batch=max_batch,
             max_wait_ms=max_wait_ms,
@@ -180,11 +194,21 @@ class ServingEngine:
         than return near-empty results), and ``merge_mode`` pins their
         cross-shard exchange engine (``"auto"`` | ``"ring"`` |
         ``"gather"``).
+
+        ``algo="tiered"`` registers a pre-built
+        :class:`raft_tpu.tiered.TieredIndex` (its store, refine ratio and
+        params travel with the object). With the engine's
+        ``hbm_budget_bytes`` set, a ``dataset`` that the
+        :mod:`~raft_tpu.ops.pallas.hbm_model` planner cannot fit next to
+        the already-registered indexes is transparently rewrapped in a
+        :class:`~raft_tpu.tiered.HostVectorStore` — registration degrades
+        to tiered serving instead of OOMing at first dispatch.
         """
         expects(algo in _DEFAULT_MODES, "unknown serving algo %r (want one of %s)",
                 algo, ", ".join(sorted(_DEFAULT_MODES)))
         if algo.startswith("sharded_"):
             expects(mesh is not None, "sharded algo %r needs mesh=", algo)
+        dataset = self._plan_tier(index_id, algo, index, dataset)
         self._indexes[index_id] = _Registration(
             index_id=index_id,
             algo=algo,
@@ -198,6 +222,45 @@ class ServingEngine:
             merge_mode=merge_mode,
             search_kwargs=dict(search_kwargs),
         )
+
+    def _plan_tier(self, index_id: str, algo: str, index, dataset):
+        """Consult the HBM placement planner for this registration.
+
+        With no budget, or an algo the model does not cover, the dataset
+        passes through untouched. Otherwise the index's measured
+        residency joins the fleet plan: required (scan) components must
+        fit — an infeasible plan is a typed registration error — and a
+        refine dataset the plan spills is rewrapped as a
+        :class:`~raft_tpu.tiered.HostVectorStore`, so dispatch gathers
+        winners from host RAM instead of holding the raw f32 slab in HBM.
+        """
+        if self.hbm_budget_bytes is None or algo not in _TIERABLE_ALGOS:
+            return dataset
+        from raft_tpu.neighbors.refine import is_host_dataset
+        from raft_tpu.ops.pallas.hbm_model import plan_placement, residency_for_index
+
+        refine_rows = 0
+        if dataset is not None and not is_host_dataset(dataset):
+            refine_rows = int(np.shape(dataset)[0])
+        res = residency_for_index(index_id, algo, index, refine_rows=refine_rows)
+        fleet = [r for iid, r in self._residencies.items() if iid != index_id]
+        placement = plan_placement(fleet + [res], hbm_budget=self.hbm_budget_bytes)
+        expects(
+            placement.feasible,
+            "registering %r needs %d B of scan-resident HBM against a budget "
+            "of %d B — required components cannot tier to the host; shard or "
+            "shrink the index",
+            index_id, sum(r.required_bytes for r in fleet) + res.required_bytes,
+            self.hbm_budget_bytes,
+        )
+        self._residencies[index_id] = res
+        self.placement = placement
+        if refine_rows and placement.tier(index_id, "raw_vectors") == "host":
+            from raft_tpu.tiered import HostVectorStore
+
+            dataset = HostVectorStore(np.asarray(dataset))
+            obs.inc("serve.tiered_degrades", index_id=index_id, algo=algo)
+        return dataset
 
     def register_mutable(
         self,
@@ -399,7 +462,7 @@ class ServingEngine:
                 )
                 zeros = np.zeros((key.bucket, dim), np.float32)
                 out = tuple(prog(zeros, snap) if snap is not None else prog(zeros))
-                np.asarray(out[0])  # block until the compile+run completes
+                np.asarray(out[0])  # block until the compile+run completes  # graft-lint: ignore[sync-transfer-in-loop] — warmup exists to block on each compile
         return built
 
     # -- internals ---------------------------------------------------------
@@ -449,13 +512,19 @@ class ServingEngine:
             # the snapshot is NOT baked into the closure — it arrives per
             # dispatch, so a cached program can never serve a stale view
             return lambda q, snap: snap.search(q, k, params=reg.params, **kw)
+        if reg.algo == "tiered":
+            # "auto" defers to the TieredIndex's own per-family default
+            t_mode = None if reg.mode == "auto" else reg.mode
+            return lambda q: reg.index.search(q, k, mode=t_mode, **kw)
         if reg.algo == "brute_force":
             return lambda q: brute_force.search(
-                reg.index, q, k, query_batch=bucket, mode=reg.mode, **kw
+                reg.index, q, k, query_batch=bucket, mode=reg.mode,
+                dataset=reg.dataset, **kw
             )
         if reg.algo == "ivf_flat":
             return lambda q: ivf_flat.search(
-                reg.index, q, k, reg.params, query_batch=bucket, mode=reg.mode, **kw
+                reg.index, q, k, reg.params, query_batch=bucket, mode=reg.mode,
+                dataset=reg.dataset, **kw
             )
         if reg.algo == "ivf_pq":
             return lambda q: ivf_pq.search(

@@ -1,0 +1,24 @@
+"""Out-of-core tiered serving: HBM-resident codes, host-resident vectors.
+
+The FusionANNS split (ROADMAP item 2, PAPERS.md arXiv 2409.16576) for
+TPU: the compressed scan (PQ/RaBitQ codes, coarse centroids, id maps)
+stays device-resident, while the raw f32 vectors that only the
+``refine`` re-rank reads live in host RAM — pinned numpy, or memory-
+mapped straight out of a v4 snapshot file — and are fetched per batch
+as a top-candidates gather, overlapped with the next micro-batch's scan.
+
+* :class:`HostVectorStore` — the host tier: double-buffered staging
+  gather (``np.take`` → ``device_put`` slab), ``host.fetch`` fault seam,
+  seeded-backoff retry, ``tiered.fetch.*`` metrics, optional mmap.
+* :class:`TieredIndex` — wraps an ivf_pq / ivf_flat / brute_force index
+  with the scan → fetch → re-rank pipeline; results are bit-identical
+  to the all-in-HBM ``search(dataset=...)`` path.
+* :func:`raft_tpu.ops.pallas.hbm_model.plan_placement` decides which
+  components spill to this tier; :class:`raft_tpu.serve.ServingEngine`
+  consults it at ``register()`` so oversubscribing HBM degrades to
+  tiered serving instead of OOMing.
+"""
+from raft_tpu.tiered.store import HostVectorStore
+from raft_tpu.tiered.index import TieredIndex
+
+__all__ = ["HostVectorStore", "TieredIndex"]

@@ -1,0 +1,189 @@
+"""The host tier: raw vectors in host RAM (or mmap), gathered per batch.
+
+A :class:`HostVectorStore` stands in for the ``dataset`` argument of
+:func:`raft_tpu.neighbors.refine.refine` (and the integrated refine of
+ivf_pq / ivf_flat / brute_force ``search``): instead of a device-resident
+``dataset[ids]`` gather inside the jit, the store runs ``np.take`` on
+host memory into a double-buffered staging slab that the re-rank jit
+transfers up. Rows never touch HBM except as the ``[batch, n_cand, dim]``
+winner slab — which is what lets a corpus exceed device memory by the
+inverse of its code compression ratio.
+
+Every gather crosses the ``host.fetch`` fault seam (latency injection
+lands inside the timed fetch window, so chaos tests can watch the
+overlap pipeline absorb it) and is retried with seeded backoff before
+surfacing a typed :class:`raft_tpu.core.errors.HostFetchError`.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.errors import HostFetchError, expects
+from raft_tpu.robust import faults
+from raft_tpu.robust.retry import RetryError, RetryPolicy, retry_call
+
+#: serialized-snapshot kind tag for a standalone host-tier vector file
+_KIND = "host_vectors"
+_VERSION = 1
+
+#: retries for a transient host fetch failure (mmap IO error, injected
+#: chaos). Short fuse: the fetch sits on the query path, so the policy
+#: is "two quick retries, then fail typed" rather than patient backoff.
+FETCH_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.005, max_delay_s=0.1)
+
+
+class HostVectorStore:
+    """Host-resident ``[n_rows, dim]`` vectors with a staged batch gather.
+
+    ``data`` may be any numpy array (kept as-is, C-contiguous copy only
+    if needed) or an ``np.memmap`` from :meth:`open` — the gather path
+    is identical, the OS pages mmap rows in on first touch.
+
+    The staging slab is double-buffered: ``gather`` alternates between
+    two host buffers per result shape, so the overlap pipeline can hand
+    slab N to the device while slab N+1 is being filled without either
+    copy racing the other.
+    """
+
+    #: duck-type marker consumed by :func:`raft_tpu.neighbors.refine.is_host_dataset`
+    is_host_tier = True
+
+    def __init__(
+        self,
+        data,
+        *,
+        retry_policy: RetryPolicy = FETCH_RETRY,
+        source_path: Optional[str] = None,
+    ):
+        if not isinstance(data, np.memmap):
+            data = np.ascontiguousarray(data)
+        expects(data.ndim == 2, "host vector store needs [n_rows, dim] data")
+        self._data = data
+        self._retry = retry_policy
+        self.source_path = source_path
+        # staging: shape -> [buf_a, buf_b]; _flip picks the live one
+        self._staging = {}
+        self._flip = 0
+
+    # -- array-protocol surface the refine path reads -----------------------
+
+    @property
+    def shape(self):
+        return self._data.shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(self._data.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self._data.shape[1])
+
+    @property
+    def nbytes(self) -> int:
+        return int(self._data.nbytes)
+
+    @property
+    def is_mmap(self) -> bool:
+        return isinstance(self._data, np.memmap)
+
+    def __len__(self) -> int:
+        return self.size
+
+    # -- the gather ----------------------------------------------------------
+
+    def _staging_slab(self, shape) -> np.ndarray:
+        bufs = self._staging.get(shape)
+        if bufs is None:
+            bufs = [np.empty(shape, self._data.dtype) for _ in range(2)]
+            self._staging[shape] = bufs
+        self._flip ^= 1
+        return bufs[self._flip]
+
+    def gather(self, candidates: np.ndarray) -> np.ndarray:
+        """Fetch the candidate rows: ``[nq, n_cand] i32`` ids (-1 =
+        invalid, substituted by row 0 exactly like the device gather in
+        ``refine._refine_impl``) -> ``[nq, n_cand, dim]`` staging slab.
+
+        Counted in ``tiered.fetch.rows`` / ``tiered.fetch.bytes``, timed
+        into the ``tiered.fetch_ms`` histogram; crosses the
+        ``host.fetch`` fault seam under retry."""
+        c = np.asarray(candidates)
+        expects(c.ndim == 2, "candidates must be [nq, n_cand]")
+        safe = np.where(c >= 0, c, 0).reshape(-1)
+        out = self._staging_slab(c.shape + (self.dim,))
+        t0 = time.perf_counter()
+
+        def _fetch():
+            faults.fire("host.fetch", rows=int(safe.size))
+            np.take(self._data, safe, axis=0, out=out.reshape(-1, self.dim))
+            return out
+
+        try:
+            slab = retry_call(_fetch, policy=self._retry, op="host.fetch")
+        except RetryError as e:
+            raise HostFetchError(
+                "host-tier vector fetch failed",
+                rows=int(safe.size), attempts=e.attempts,
+            ) from e.last
+        if obs.is_enabled():
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            obs.inc("tiered.fetch.rows", float(safe.size))
+            obs.inc("tiered.fetch.bytes", float(slab.nbytes))
+            obs.observe("tiered.fetch_ms", dt_ms)
+        return slab
+
+    # -- persistence ---------------------------------------------------------
+
+    @staticmethod
+    def save(path: str, data) -> str:
+        """Write a standalone host-vector snapshot (v4 checksummed
+        envelope, atomic temp-then-rename) that :meth:`open` can load
+        eagerly or map lazily."""
+        host = np.ascontiguousarray(np.asarray(data))
+        expects(host.ndim == 2, "host vector store needs [n_rows, dim] data")
+        import io
+
+        body = io.BytesIO()
+        ser.serialize_array(body, host)
+        return ser.atomic_write(
+            path, lambda f: ser.save_stream(f, _KIND, _VERSION, body.getvalue())
+        )
+
+    @classmethod
+    def open(
+        cls,
+        path: str,
+        *,
+        mmap: bool = True,
+        verify_crc: bool = True,
+        retry_policy: RetryPolicy = FETCH_RETRY,
+    ) -> "HostVectorStore":
+        """Open a snapshot written by :meth:`save`.
+
+        ``mmap=True`` maps the npy payload read-only in place (CRC
+        verified by streaming once up front unless ``verify_crc=False``)
+        — resident set grows only with the rows queries actually touch.
+        ``mmap=False`` materializes the array in host RAM."""
+        if mmap:
+            _, offset, _ = ser.open_payload(path, _KIND, verify_crc=verify_crc)
+            arr, _ = ser.mmap_array_at(path, offset)
+            return cls(arr, retry_policy=retry_policy, source_path=path)
+        with open(path, "rb") as f:
+            _, body = ser.load_stream(f, _KIND)
+            name = ser.deserialize_string(body)
+            arr = np.load(body, allow_pickle=False)
+            if name != arr.dtype.name:  # bfloat16 stored as a uint16 view
+                import jax.numpy as jnp
+
+                arr = arr.view(jnp.dtype(name))
+        return cls(arr, retry_policy=retry_policy, source_path=path)

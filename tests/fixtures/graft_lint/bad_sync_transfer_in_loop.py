@@ -1,0 +1,34 @@
+"""Seeded violation: sync-transfer-in-loop (exactly one).
+
+The loop dispatches `scan` and then immediately materializes its result
+on the host — the device sits idle during every np.asarray and the host
+sits idle during every scan. The overlapped (double-buffered) form in
+`negative_double_buffer` below is the fix and must stay clean.
+"""
+import numpy as np
+
+
+def scan(chunk):
+    return chunk * 2  # stands in for an async jitted dispatch
+
+
+def serial_pipeline(chunks):
+    out = []
+    for chunk in chunks:
+        cand = scan(chunk)
+        cand_np = np.asarray(cand)  # LINT-HERE
+        out.append(cand_np.sum())
+    return out
+
+
+def negative_double_buffer(chunks):
+    # the overlap seam: block on iteration i only after dispatching
+    # i+1 — `cur` is bound from a Name, not from the dispatch call
+    out = []
+    nxt = scan(chunks[0])
+    for i in range(len(chunks)):
+        cur = nxt
+        if i + 1 < len(chunks):
+            nxt = scan(chunks[i + 1])
+        out.append(np.asarray(cur).sum())
+    return out

@@ -142,3 +142,31 @@ def gather_then_pick(blocks, sizes, root, axis):
     b = jax.lax.all_gather(blocks, axis)
     s = jax.lax.all_gather(sizes, axis)
     return b[root], s[root]
+
+
+@jax.jit
+def _stage(x):
+    return jnp.tanh(x)
+
+
+def overlapped_pipeline(chunks):
+    # sync-transfer-in-loop negative space: the double-buffer idiom —
+    # iteration i blocks only after i+1's work is in flight, and the
+    # blocked-on name (`cur`) is bound from a Name, not a dispatch
+    out = []
+    nxt = _stage(chunks[0])
+    for i in range(len(chunks)):
+        cur = nxt
+        if i + 1 < len(chunks):
+            nxt = _stage(chunks[i + 1])
+        out.append(np.asarray(cur))
+    return out
+
+
+def hoisted_sync(chunks):
+    # dispatch everything, then one sync outside the loop: also fine
+    ys = []
+    for c in chunks:
+        y = _stage(c)
+        ys.append(y)
+    return [np.asarray(y) for y in ys]

@@ -35,6 +35,7 @@ BAD = {
     "bad_unbounded_queue.py": "unbounded-queue",
     "bad_non_atomic_write.py": "non-atomic-write",
     "bad_blocking_under_lock.py": "blocking-under-lock",
+    "bad_sync_transfer_in_loop.py": "sync-transfer-in-loop",
 }
 
 

@@ -852,13 +852,21 @@ class TestDeltaFusedScan:
         from raft_tpu.mutable.segments import _delta_route
         from raft_tpu.ops.distance import DistanceType
 
+        from raft_tpu.mutable.segments import (
+            _DELTA_FUSED_MAX_BANKS,
+            _DELTA_FUSED_MAX_ROWS,
+        )
+
         l2 = DistanceType.L2Expanded
+        over = _DELTA_FUSED_MAX_ROWS * _DELTA_FUSED_MAX_BANKS * 2
         assert _delta_route("exact", l2, 256, 10) == "exact"
         assert _delta_route("fused", l2, 1024, 10) == "fused"
-        # over the lossless bank window, auto falls back to exact
-        assert _delta_route("auto", l2, 2048, 10) == "exact"
+        # past one bank the scan tiles — still fused, still lossless
+        assert _delta_route("fused", l2, 2048, 10) == "fused"
+        # over the banked window, auto falls back to exact
+        assert _delta_route("auto", l2, over, 10) == "exact"
         with pytest.raises(LogicError):
-            _delta_route("fused", l2, 2048, 10)  # forced but ineligible
+            _delta_route("fused", l2, over, 10)  # forced but ineligible
         with pytest.raises(LogicError):
             _delta_route("fused", l2, 256, 300)  # k past one extract width
         with pytest.raises(LogicError):
@@ -879,6 +887,47 @@ class TestDeltaFusedScan:
         assert set(np.asarray(i)[:, :3].ravel()) <= {0, 1, 2}
         assert (np.asarray(i)[:, 3:] == -1).all()
         assert np.isinf(np.asarray(d)[:, 3:]).all()
+
+    @pytest.mark.parametrize("metric", ["l2", "l2sqrt", "ip"])
+    def test_banked_fused_matches_exact_past_one_bank(self, rng, metric):
+        """The fused path must stay engaged past the old 1024-row cap:
+        a 1300-row delta pads to 2048 -> two banks, and the banked
+        k-way merge must reproduce the exact scan's ids bit-for-bit
+        (tombstones included)."""
+        from raft_tpu.ops.distance import DistanceType
+
+        m = {
+            "l2": DistanceType.L2Expanded,
+            "l2sqrt": DistanceType.L2SqrtExpanded,
+            "ip": DistanceType.InnerProduct,
+        }[metric]
+        mut = MutableIndex("brute_force", DIM, metric=m)
+        ids = mut.insert(_rows(rng, 1300))
+        mut.delete(ids[5:45])
+        queries = _rows(rng, 33)
+        snap = mut.snapshot()
+        assert int(snap.delta_bf.size) > 1024  # really multi-bank
+        d_ex, i_ex = dataclasses.replace(snap, delta_mode="exact").search(queries, 10)
+        d_fu, i_fu = dataclasses.replace(snap, delta_mode="fused").search(queries, 10)
+        np.testing.assert_array_equal(np.asarray(i_ex), np.asarray(i_fu))
+        np.testing.assert_allclose(
+            np.asarray(d_ex), np.asarray(d_fu), rtol=1e-6, atol=1e-6
+        )
+
+    def test_banked_fused_publishes_bank_gauge(self, rng):
+        from raft_tpu.ops.distance import DistanceType
+
+        mut = MutableIndex("brute_force", DIM, metric=DistanceType.L2Expanded)
+        mut.insert(_rows(rng, 1300))
+        snap = dataclasses.replace(mut.snapshot(), delta_mode="fused")
+        obs.enable()
+        try:
+            snap.search(_rows(rng, 3), 5)
+            gauges = obs.registry().as_dict()["gauges"]
+            assert gauges["mutable.delta.banks"] == 2.0
+        finally:
+            obs.disable()
+            obs.registry().reset()
 
 
 # -- snapshot-consistent serving + bounded recompiles -----------------------

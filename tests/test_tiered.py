@@ -1,0 +1,411 @@
+"""Tiered-serving tests: the acceptance gate of the out-of-core PR.
+
+Four claims, each load-bearing:
+
+* **bit-parity** — for every refine-capable family (ivf_pq nibble,
+  ivf_pq rabitq, ivf_flat, brute_force), a :class:`TieredIndex` over a
+  :class:`HostVectorStore` must return distances AND ids bit-identical
+  to the family's all-resident ``search(dataset=...)`` path, overlapped
+  or sequential, mmap'd or in-RAM;
+* **placement** — the :mod:`~raft_tpu.ops.pallas.hbm_model` residency
+  estimates equal the built index's real ``arr.nbytes``, and
+  :func:`plan_placement` spills the raw-vector slab (largest first)
+  while required scan components stay device-bound or fail typed;
+* **degrade** — a :class:`~raft_tpu.serve.engine.ServingEngine` with an
+  ``hbm_budget_bytes`` rewraps an over-budget refine dataset in a host
+  store at registration, and serves bit-identical results through it;
+* **chaos** — injected latency at the ``host.fetch`` seam changes
+  timing, never results; transient fetch failure is retried; permanent
+  failure surfaces a typed :class:`HostFetchError` with the attempt
+  count.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.core.errors import CorruptIndexError, HostFetchError, LogicError
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.ops.pallas.hbm_model import (
+    HbmComponent,
+    brute_force_residency,
+    ivf_pq_residency,
+    plan_placement,
+    residency_for_index,
+)
+from raft_tpu.robust import faults
+from raft_tpu.tiered import HostVectorStore, TieredIndex
+
+N, DIM, K, MB = 3000, 48, 10, 256
+
+
+@pytest.fixture(scope="module")
+def data():
+    return np.random.default_rng(7).standard_normal((N, DIM)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    return np.random.default_rng(8).standard_normal((900, DIM)).astype(np.float32)
+
+
+def _family(name, data):
+    """(algo, index, search_params, resident_search) for one family."""
+    if name == "ivf_pq":
+        idx = ivf_pq.build(
+            data, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, kmeans_n_iters=5, seed=1)
+        )
+        sp = ivf_pq.IvfPqSearchParams(n_probes=8, refine_ratio=4)
+        res = lambda q: ivf_pq.search(
+            idx, q, K, sp, query_batch=MB, mode="auto", dataset=data
+        )
+        return "ivf_pq", idx, ivf_pq.IvfPqSearchParams(n_probes=8, refine_ratio=4), res
+    if name == "rabitq":
+        idx = ivf_pq.build(
+            data, ivf_pq.IvfPqIndexParams(pq_bits=1, n_lists=8, kmeans_n_iters=5, seed=2)
+        )
+        sp = ivf_pq.IvfPqSearchParams(n_probes=8, refine_ratio=4)
+        res = lambda q: ivf_pq.search(
+            idx, q, K, sp, query_batch=MB, mode="auto", dataset=data
+        )
+        return "ivf_pq", idx, sp, res
+    if name == "ivf_flat":
+        idx = ivf_flat.build(
+            data, ivf_flat.IvfFlatIndexParams(n_lists=8, kmeans_n_iters=5, seed=3)
+        )
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=8, refine_ratio=4)
+        res = lambda q: ivf_flat.search(
+            idx, q, K, sp, query_batch=MB, mode="auto", dataset=data
+        )
+        return "ivf_flat", idx, sp, res
+    idx = brute_force.build(data)
+    res = lambda q: brute_force.search(
+        idx, q, K, query_batch=MB, mode="exact", dataset=data, refine_ratio=4
+    )
+    return "brute_force", idx, None, res
+
+
+FAMILY_NAMES = ("ivf_pq", "rabitq", "ivf_flat", "brute_force")
+
+
+# -- bit-parity ----------------------------------------------------------------
+
+
+class TestBitParity:
+    @pytest.mark.parametrize("name", FAMILY_NAMES)
+    @pytest.mark.parametrize("overlap", [True, False])
+    def test_tiered_equals_resident(self, name, overlap, data, queries):
+        algo, idx, sp, resident = _family(name, data)
+        ti = TieredIndex(
+            algo, idx, HostVectorStore(data),
+            refine_ratio=4, micro_batch=MB, search_params=sp,
+        )
+        d_ref, i_ref = map(np.asarray, resident(queries))
+        d_t, i_t = ti.search(queries, K, overlap=overlap)
+        np.testing.assert_array_equal(i_t, i_ref)
+        np.testing.assert_array_equal(d_t, d_ref)
+
+    def test_single_partial_batch(self, data, queries):
+        """A query set smaller than one micro-batch (no pipeline)."""
+        algo, idx, sp, resident = _family("ivf_pq", data)
+        ti = TieredIndex(algo, idx, HostVectorStore(data),
+                         refine_ratio=4, micro_batch=MB, search_params=sp)
+        q = queries[:7]
+        d_ref, i_ref = map(np.asarray, resident(q))
+        d_t, i_t = ti.search(q, K)
+        np.testing.assert_array_equal(i_t, i_ref)
+        np.testing.assert_array_equal(d_t, d_ref)
+
+    def test_corpus_exceeds_4x_device_budget(self):
+        """The acceptance ratio: raw vectors >= 4x the HBM the planner
+        would grant the scan — the shape where tiering is mandatory.
+        Wide rows make the point: raw bytes scale with dim, PQ codes
+        do not."""
+        rng = np.random.default_rng(11)
+        wide = rng.standard_normal((6000, 128)).astype(np.float32)
+        idx = ivf_pq.build(
+            wide,
+            ivf_pq.IvfPqIndexParams(
+                n_lists=16, pq_dim=16, pq_bits=4, kmeans_n_iters=4, seed=5
+            ),
+        )
+        sp = ivf_pq.IvfPqSearchParams(n_probes=16, refine_ratio=4)
+        store = HostVectorStore(wide)
+        res = residency_for_index("big", "ivf_pq", idx, refine_rows=wide.shape[0])
+        budget = int(res.required_bytes / 0.9) + (8 << 10)
+        assert store.nbytes >= 4 * budget, (
+            f"corpus {store.nbytes} B must be >= 4x device budget {budget} B"
+        )
+        placement = plan_placement([res], hbm_budget=budget)
+        assert placement.feasible and placement.tier("big", "raw_vectors") == "host"
+        ti = TieredIndex("ivf_pq", idx, store, refine_ratio=4, micro_batch=MB,
+                         search_params=sp)
+        q = rng.standard_normal((500, 128)).astype(np.float32)
+        d_ref, i_ref = map(
+            np.asarray,
+            ivf_pq.search(idx, q, K, sp, query_batch=MB, mode="auto", dataset=wide),
+        )
+        d_t, i_t = ti.search(q, K)
+        np.testing.assert_array_equal(i_t, i_ref)
+        np.testing.assert_array_equal(d_t, d_ref)
+
+
+# -- store: gather + persistence ----------------------------------------------
+
+
+class TestHostVectorStore:
+    def test_gather_substitutes_invalid_like_device_path(self, data):
+        store = HostVectorStore(data)
+        cand = np.array([[5, -1, 17], [-1, 0, 2]], np.int32)
+        slab = store.gather(cand)
+        assert slab.shape == (2, 3, DIM)
+        np.testing.assert_array_equal(slab[0, 1], data[0])  # -1 -> row 0
+        np.testing.assert_array_equal(slab[0, 2], data[17])
+
+    def test_double_buffered_staging(self, data):
+        store = HostVectorStore(data)
+        cand = np.array([[1, 2]], np.int32)
+        a = store.gather(cand)
+        b = store.gather(np.array([[3, 4]], np.int32))
+        # the previous slab must survive the next gather (overlap window)
+        assert a is not b
+        np.testing.assert_array_equal(a[0, 0], data[1])
+        np.testing.assert_array_equal(b[0, 0], data[3])
+
+    def test_mmap_roundtrip_bit_parity(self, tmp_path, data, queries):
+        path = str(tmp_path / "vectors.bin")
+        HostVectorStore.save(path, data)
+        mm = HostVectorStore.open(path, mmap=True)
+        eager = HostVectorStore.open(path, mmap=False)
+        assert mm.is_mmap and not eager.is_mmap
+        np.testing.assert_array_equal(np.asarray(mm._data), data)
+        algo, idx, sp, resident = _family("ivf_pq", data)
+        d_ref, i_ref = map(np.asarray, resident(queries[:300]))
+        for store in (mm, eager):
+            ti = TieredIndex(algo, idx, store, refine_ratio=4, micro_batch=MB,
+                             search_params=sp)
+            d_t, i_t = ti.search(queries[:300], K)
+            np.testing.assert_array_equal(i_t, i_ref)
+            np.testing.assert_array_equal(d_t, d_ref)
+
+    def test_corrupt_file_fails_typed(self, tmp_path, data):
+        path = str(tmp_path / "vectors.bin")
+        HostVectorStore.save(path, data)
+        blob = bytearray(open(path, "rb").read())
+        blob[-100] ^= 0xFF  # flip a payload byte
+        with open(path, "wb") as f:
+            f.write(blob)
+        with pytest.raises(CorruptIndexError):
+            HostVectorStore.open(path, mmap=True)
+
+    def test_bad_shape_rejected(self):
+        with pytest.raises(LogicError):
+            HostVectorStore(np.zeros(8, np.float32))
+
+
+# -- refine dataset validation -------------------------------------------------
+
+
+class TestRefineValidation:
+    def test_ivf_pq_short_dataset_fails_up_front(self, data, queries):
+        algo, idx, sp, _ = _family("ivf_pq", data)
+        with pytest.raises(LogicError, match=r"holds \d+ vectors"):
+            ivf_pq.search(idx, queries[:4], K, sp, dataset=data[: N // 2])
+
+    def test_ivf_flat_short_dataset_fails_up_front(self, data, queries):
+        algo, idx, sp, _ = _family("ivf_flat", data)
+        with pytest.raises(LogicError, match="ivf_flat refine dataset"):
+            ivf_flat.search(idx, queries[:4], K, sp, dataset=data[:100])
+
+    def test_brute_force_short_dataset_fails_up_front(self, data, queries):
+        idx = brute_force.build(data)
+        with pytest.raises(LogicError, match="brute_force refine dataset"):
+            brute_force.search(idx, queries[:4], K, dataset=data[:100], refine_ratio=4)
+
+    def test_tiered_short_store_fails_at_construction(self, data):
+        algo, idx, sp, _ = _family("ivf_pq", data)
+        with pytest.raises(LogicError, match="HostVectorStore"):
+            TieredIndex(algo, idx, HostVectorStore(data[: N // 2]), search_params=sp)
+
+
+# -- HBM model ----------------------------------------------------------------
+
+
+class TestHbmModel:
+    def test_residency_matches_measured_nbytes_ivf_pq(self, data):
+        _, idx, _, _ = _family("ivf_pq", data)
+        res = residency_for_index("x", "ivf_pq", idx, refine_rows=N)
+        actual = {
+            "codes": idx.codes, "centers": idx.centers,
+            "centers_rot": idx.centers_rot, "rotation": idx.rotation,
+            "codebook": idx.pq_centers, "ids": idx.list_indices,
+            "sqnorms": idx.rot_sqnorms,
+        }
+        for name, arr in actual.items():
+            assert res.by_name(name).nbytes == np.asarray(arr).nbytes, name
+        assert res.by_name("raw_vectors").nbytes == data.nbytes
+        assert not res.by_name("raw_vectors").required
+
+    def test_residency_matches_measured_nbytes_ivf_flat(self, data):
+        _, idx, _, _ = _family("ivf_flat", data)
+        res = residency_for_index("x", "ivf_flat", idx)
+        for name, arr in (
+            ("list_data", idx.list_data), ("centers", idx.centers),
+            ("ids", idx.list_indices), ("norms", idx.list_norms),
+        ):
+            assert res.by_name(name).nbytes == np.asarray(arr).nbytes, name
+
+    def test_residency_matches_measured_nbytes_brute_force(self, data):
+        idx = brute_force.build(data)
+        res = residency_for_index("x", "brute_force", idx)
+        assert res.by_name("dataset").nbytes == np.asarray(idx.dataset).nbytes
+
+    def test_parametric_estimator_agrees_with_shapes(self):
+        res = brute_force_residency("b", n_rows=1000, dim=64, refine_rows=1000)
+        assert res.by_name("dataset").nbytes == 1000 * 64 * 4
+        assert res.by_name("raw_vectors").nbytes == 1000 * 64 * 4
+        pq = ivf_pq_residency(
+            "p", n_rows=1000, dim=64, n_lists=10, pq_dim=16, pq_bits=8
+        )
+        assert pq.by_name("codes").nbytes == 10 * 100 * 16
+
+    def test_plan_spills_largest_raw_slab_first(self):
+        small = brute_force_residency("small", n_rows=100, dim=32, refine_rows=100)
+        big = brute_force_residency("big", n_rows=10_000, dim=32, refine_rows=10_000)
+        required = small.required_bytes + big.required_bytes
+        # room for the required parts + the small slab only
+        budget = int((required + small.optional_bytes + 1024) / 0.9)
+        p = plan_placement([big, small], hbm_budget=budget)
+        assert p.feasible
+        assert p.tier("small", "raw_vectors") == "device"
+        assert p.tier("big", "raw_vectors") == "host"
+        assert p.spilled("big") and not p.spilled("small")
+        assert p.host_bytes == big.optional_bytes
+
+    def test_required_overflow_is_infeasible(self):
+        big = brute_force_residency("big", n_rows=10_000, dim=32)
+        p = plan_placement([big], hbm_budget=1024)
+        assert not p.feasible
+        assert "INFEASIBLE" in p.table()
+
+
+# -- serving-engine degrade ----------------------------------------------------
+
+
+class TestEngineDegrade:
+    def _engine_case(self, data, queries, budget):
+        from raft_tpu.serve.engine import ServingEngine
+
+        algo, idx, sp, resident = _family("ivf_pq", data)
+        eng = ServingEngine(max_batch=32, hbm_budget_bytes=budget)
+        eng.register("a", "ivf_pq", idx, params=sp, dataset=data)
+        fut = eng.submit("a", queries[:8], k=K)
+        eng.run_until_idle()
+        return eng, fut.result(), resident
+
+    def test_over_budget_registration_degrades_to_tiered(self, data, queries):
+        _, idx, _, _ = _family("ivf_pq", data)
+        res = residency_for_index("a", "ivf_pq", idx, refine_rows=N)
+        budget = int((res.required_bytes + res.optional_bytes // 2) / 0.9)
+        eng, out, resident = self._engine_case(data, queries, budget)
+        from raft_tpu.neighbors.refine import is_host_dataset
+
+        assert is_host_dataset(eng._indexes["a"].dataset)
+        assert eng.placement.spilled("a")
+        d_ref, i_ref = map(np.asarray, resident(queries[:8]))
+        np.testing.assert_array_equal(out.indices, i_ref[:, :K])
+
+    def test_under_budget_registration_stays_resident(self, data, queries):
+        _, idx, _, _ = _family("ivf_pq", data)
+        res = residency_for_index("a", "ivf_pq", idx, refine_rows=N)
+        budget = int(res.total_bytes / 0.9) + (1 << 20)
+        eng, out, resident = self._engine_case(data, queries, budget)
+        from raft_tpu.neighbors.refine import is_host_dataset
+
+        assert not is_host_dataset(eng._indexes["a"].dataset)
+        assert not eng.placement.spilled("a")
+
+    def test_infeasible_budget_fails_typed(self, data):
+        from raft_tpu.serve.engine import ServingEngine
+
+        _, idx, sp, _ = _family("ivf_pq", data)
+        eng = ServingEngine(hbm_budget_bytes=1024)
+        with pytest.raises(LogicError, match="scan-resident"):
+            eng.register("a", "ivf_pq", idx, params=sp, dataset=data)
+
+    def test_register_tiered_index_directly(self, data, queries):
+        from raft_tpu.serve.engine import ServingEngine
+
+        algo, idx, sp, resident = _family("ivf_pq", data)
+        ti = TieredIndex(algo, idx, HostVectorStore(data), refine_ratio=4,
+                         micro_batch=32, search_params=sp)
+        eng = ServingEngine(max_batch=32)
+        eng.register("t", "tiered", ti)
+        fut = eng.submit("t", queries[:8], k=K)
+        eng.run_until_idle()
+        out = fut.result()
+        d_ref, i_ref = map(np.asarray, resident(queries[:8]))
+        np.testing.assert_array_equal(out.indices, i_ref)
+
+
+# -- chaos at host.fetch -------------------------------------------------------
+
+
+class TestHostFetchChaos:
+    def test_latency_injection_never_changes_results(self, data, queries):
+        algo, idx, sp, resident = _family("ivf_pq", data)
+        ti = TieredIndex(algo, idx, HostVectorStore(data), refine_ratio=4,
+                         micro_batch=MB, search_params=sp)
+        q = queries[:600]
+        d_ref, i_ref = ti.search(q, K)
+        with faults.injected("host.fetch", latency_s=0.01):
+            d_sl, i_sl = ti.search(q, K, overlap=True)
+        np.testing.assert_array_equal(i_sl, i_ref)
+        np.testing.assert_array_equal(d_sl, d_ref)
+
+    def test_transient_failure_recovers_via_retry(self, data, queries):
+        algo, idx, sp, _ = _family("ivf_pq", data)
+        ti = TieredIndex(algo, idx, HostVectorStore(data), refine_ratio=4,
+                         micro_batch=MB, search_params=sp)
+        q = queries[:100]
+        d_ref, i_ref = ti.search(q, K)
+        with faults.injected(
+            "host.fetch", error=OSError("page fault storm"),
+            trigger="first_n", first_n=2,
+        ):
+            d_r, i_r = ti.search(q, K)
+        np.testing.assert_array_equal(i_r, i_ref)
+        np.testing.assert_array_equal(d_r, d_ref)
+
+    def test_permanent_failure_surfaces_typed_error(self, data, queries):
+        algo, idx, sp, _ = _family("ivf_pq", data)
+        ti = TieredIndex(algo, idx, HostVectorStore(data), refine_ratio=4,
+                         micro_batch=MB, search_params=sp)
+        with faults.injected("host.fetch", error=OSError("dead disk")):
+            with pytest.raises(HostFetchError) as ei:
+                ti.search(queries[:32], K)
+        assert ei.value.attempts == 3
+        assert "rows=" in str(ei.value)
+
+
+# -- observability -------------------------------------------------------------
+
+
+class TestTieredObs:
+    def test_fetch_metrics_and_overlap_gauge(self, data, queries):
+        algo, idx, sp, _ = _family("ivf_pq", data)
+        ti = TieredIndex(algo, idx, HostVectorStore(data), refine_ratio=4,
+                         micro_batch=MB, search_params=sp)
+        obs.enable()
+        try:
+            ti.search(queries[:600], K)
+            snap = obs.registry().as_dict()
+        finally:
+            obs.disable()
+            obs.registry().reset()
+        counters, gauges = snap["counters"], snap["gauges"]
+        assert counters["tiered.fetch.rows"] > 0
+        assert counters["tiered.fetch.bytes"] > 0
+        assert any(k.startswith("tiered.fetch_ms") for k in snap["histograms"])
+        assert 0.0 <= gauges["tiered.overlap_efficiency"] <= 1.0

@@ -77,10 +77,10 @@ def main():
         for name, idx in idxs.items():
             sp = ivf_pq.IvfPqSearchParams(n_probes=npr)
             _, i = ivf_pq.search(idx, queries, K, sp, mode="scan")
-            rec = float(neighborhood_recall(np.asarray(i), gt))
+            rec = float(neighborhood_recall(np.asarray(i), gt))  # graft-lint: ignore[sync-transfer-in-loop] — recall measurement; throughput not at stake
             _, cand = ivf_pq.search(idx, queries, 4 * K, sp, mode="scan")
             _, ri = refine(dataset, queries, cand, K, metric=DistanceType.L2Expanded)
-            rrec = float(neighborhood_recall(np.asarray(ri), gt))
+            rrec = float(neighborhood_recall(np.asarray(ri), gt))  # graft-lint: ignore[sync-transfer-in-loop] — recall measurement; throughput not at stake
             row = {"variant": name, "n_probes": npr,
                    "recall": round(rec, 4), "recall_refine4x": round(rrec, 4),
                    "code_bytes_per_row": int(idxs[name].codes.shape[-1])}

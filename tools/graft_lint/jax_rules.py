@@ -721,6 +721,134 @@ class UnsyncedTimingChecker(Checker):
                 starts.pop(right.id, None)
 
 
+# -- sync-transfer-in-loop --------------------------------------------------
+
+#: numpy entry points that materialize a device array on the host
+_TRANSFER_NP_FUNCS = {"asarray", "array"}
+#: jax entry points that move data across the host/device boundary
+_TRANSFER_JAX_FUNCS = {"device_get", "device_put"}
+
+
+def _base_name(node: ast.AST) -> Optional[str]:
+    """The root Name of a ``x`` / ``x[1]`` / ``x.attr[0]`` chain."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _transfer_target(node: ast.AST, imp: _Imports) -> Optional[str]:
+    """If ``node`` is a blocking host/device transfer call, return the
+    base name of the array it syncs on; else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "block_until_ready" and not node.args:
+        return _base_name(f.value)
+    if not node.args:
+        return None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id in imp.numpy and f.attr in _TRANSFER_NP_FUNCS:
+            return _base_name(node.args[0])
+        if f.value.id in imp.jax and f.attr in _TRANSFER_JAX_FUNCS:
+            return _base_name(node.args[0])
+    return None
+
+
+def _dispatch_targets(stmt: ast.stmt, imp: _Imports) -> List[str]:
+    """Names this statement binds directly from a (possibly async) device
+    dispatch: ``x = some_call(...)`` where the call is not a numpy/host
+    builtin. The loop-carried proxy for 'work was dispatched this
+    iteration'."""
+    if not isinstance(stmt, ast.Assign) or not isinstance(stmt.value, ast.Call):
+        return []
+    f = stmt.value.func
+    if isinstance(f, ast.Name) and f.id in _STATIC_CALLS:
+        return []
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id in imp.numpy
+    ):
+        return []
+    return [n for t in stmt.targets for n in _target_names(t)]
+
+
+class SyncTransferInLoopChecker(Checker):
+    rule = "sync-transfer-in-loop"
+    doc = (
+        "np.asarray/device_get/device_put/block_until_ready on a value "
+        "dispatched earlier in the same loop iteration — the transfer "
+        "serializes host and device every iteration; dispatch the next "
+        "iteration's work before blocking (double-buffer / overlap seam)."
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        imp = _module_imports(module)
+        if not (imp.numpy or imp.jax):
+            return
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            dispatched: Dict[str, bool] = {}
+            yield from self._scan(module, imp, [*loop.body, *loop.orelse], dispatched)
+
+    def _scan(
+        self,
+        module: LintModule,
+        imp: _Imports,
+        body: Sequence[ast.stmt],
+        dispatched: Dict[str, bool],
+    ) -> Iterator[Violation]:
+        for stmt in body:
+            # nested defs run on their own schedule; nested loops are
+            # scanned as their own loops (one report per pattern)
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.For, ast.While)
+            ):
+                continue
+            if isinstance(stmt, (ast.If, ast.With, ast.Try)):
+                yield from self._scan_exprs(module, imp, _stmt_exprs(stmt), dispatched)
+                if isinstance(stmt, ast.If):
+                    sub = [stmt.body, stmt.orelse]
+                elif isinstance(stmt, ast.With):
+                    sub = [stmt.body]
+                else:
+                    sub = [
+                        stmt.body,
+                        *[h.body for h in stmt.handlers],
+                        stmt.orelse,
+                        stmt.finalbody,
+                    ]
+                for b in sub:
+                    yield from self._scan(module, imp, b, dispatched)
+                continue
+            yield from self._scan_exprs(module, imp, [stmt], dispatched)
+            for name in _dispatch_targets(stmt, imp):
+                dispatched[name] = True
+
+    def _scan_exprs(
+        self,
+        module: LintModule,
+        imp: _Imports,
+        roots: Sequence[Optional[ast.AST]],
+        dispatched: Dict[str, bool],
+    ) -> Iterator[Violation]:
+        for root in roots:
+            if root is None:
+                continue
+            for node in _walk_skip_defs(root):
+                name = _transfer_target(node, imp)
+                if name is not None and dispatched.pop(name, False):
+                    yield self.violation(
+                        module, node,
+                        f"`{name}` was dispatched earlier in this loop "
+                        "iteration and is synced here — host and device run "
+                        "serially, every iteration; dispatch iteration i+1's "
+                        "work before blocking on i (double-buffer), or hoist "
+                        "the transfer out of the loop",
+                    )
+
+
 CHECKERS = [
     TracedBranchChecker(),
     NumpyInJitChecker(),
@@ -728,4 +856,5 @@ CHECKERS = [
     JitInLoopChecker(),
     ImplicitDtypeChecker(),
     UnsyncedTimingChecker(),
+    SyncTransferInLoopChecker(),
 ]

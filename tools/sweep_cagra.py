@@ -85,7 +85,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"# {tag:44s} FAILED {type(e).__name__}: {str(e)[:100]}", flush=True)
             continue
-        rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt))
+        rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt))  # graft-lint: ignore[sync-transfer-in-loop] — post-timed recall readout
         print(f"# {tag:44s} {NQ/dt:>10,.0f} {rec:>8.4f}", flush=True)
         art.add({"config": tag, "qps": round(NQ / dt, 1), "recall": round(rec, 4)})
 
@@ -97,7 +97,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"# latency batch={bq} FAILED {type(e).__name__}", flush=True)
             continue
-        rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt[:bq]))
+        rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt[:bq]))  # graft-lint: ignore[sync-transfer-in-loop] — post-timed recall readout
         print(f"# latency batch={bq:<3d} {dt*1e3:8.2f} ms  recall={rec:.4f}", flush=True)
         art.add({"config": f"latency batch={bq} w={sp.search_width}",
                  "latency_ms": round(dt * 1e3, 2), "recall": round(rec, 4)})

@@ -104,7 +104,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"# {tag:52s} FAILED {type(e).__name__}: {str(e)[:100]}", flush=True)
             continue
-        rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt))
+        rec = float(neighborhood_recall(np.asarray(i)[:, :K], gt))  # graft-lint: ignore[sync-transfer-in-loop] — post-timed recall readout
         print(f"# {tag:52s} {NQ/dt:>10,.0f} {rec:>8.4f}", flush=True)
         art.add({"config": tag, "qps": round(NQ / dt, 1), "recall": round(rec, 4)})
 
