@@ -10,8 +10,9 @@ index from scratch, and publishes the result as generation ``g+1``:
 2. flip ``MANIFEST.json`` to the new generation with
    :func:`raft_tpu.mutable.manifest.swap` — the only mutable file;
 3. switch the in-memory index over (empty delta, empty tombstones, a
-   fresh per-generation WAL) and best-effort delete the old
-   generation's artifacts.
+   fresh per-generation WAL), then — after the index lock is released,
+   so nobody queues behind filesystem work — best-effort delete the
+   old generation's artifacts.
 
 Crash matrix: a kill at the ``compact.merge`` seam (before any byte is
 written) or anywhere during step 1 leaves the old manifest pointing at
@@ -138,11 +139,19 @@ def _switch_memory(
     res=None,
     old_wal_path: Optional[str] = None,
     new_wal=None,
-) -> None:
+) -> Optional[Tuple[str, int, Optional[str]]]:
     """Install the just-published generation in memory: empty delta,
     empty tombstones, fresh id map, the new generation's WAL as the
     live log. Caller holds ``mut._lock``; the disk state is already
-    durable, so this is pure pointer surgery."""
+    durable, so this is pure pointer surgery — which is why the
+    superseded generation is NOT deleted here. Deleting it is
+    corpus-proportional filesystem work (rmtree + WAL unlinks) that
+    once ran inside this critical section and stalled every writer and
+    searcher behind it; instead the arguments for
+    :func:`_cleanup_old_generation` are returned for the caller to run
+    *after* releasing the lock (the artifacts are unreferenced the
+    moment the manifest flip landed, so when exactly they disappear is
+    irrelevant to correctness)."""
     mut._id_loc.clear()
     dim = mut.dim
     mut._delta_data = np.zeros((seg._DELTA_MIN_CAP, dim), np.float32)
@@ -165,7 +174,8 @@ def _switch_memory(
                 os.path.join(mut.directory, seg._wal_name(new_gen)),
                 max_bytes=mut.max_wal_bytes,
             )
-        _cleanup_old_generation(mut.directory, new_gen - 1, old_wal_path)
+        return (mut.directory, new_gen - 1, old_wal_path)
+    return None
 
 
 def _note_compaction(mut: "seg.MutableIndex", mode: str, rows: int, t0: float) -> None:
@@ -205,11 +215,17 @@ def _compact_once(mut: "seg.MutableIndex", res=None) -> int:
             rows_rel, main_rel = _write_generation(  # graft-lint: ignore[blocking-under-lock] — foreground mode writes artifacts under the lock by contract
                 mut, new_gen, ids, vecs, index
             )
-            _publish(mut, new_gen, rows_rel, main_rel)  # graft-lint: ignore[blocking-under-lock] — the flip itself is one fsync'd rename
+            _publish(mut, new_gen, rows_rel, main_rel)
         # the new generation is durable and live on disk — switch memory
-        _switch_memory(mut, new_gen, ids, vecs, index, res=res, old_wal_path=old_wal_path)
+        pending_cleanup = _switch_memory(
+            mut, new_gen, ids, vecs, index, res=res, old_wal_path=old_wal_path
+        )
         _note_compaction(mut, "sync", len(ids), t0)
-        return new_gen
+    # the superseded generation's artifacts are unreferenced once the
+    # flip landed — delete them only after releasing the index lock
+    if pending_cleanup is not None:
+        _cleanup_old_generation(*pending_cleanup)
+    return new_gen
 
 
 def compact(

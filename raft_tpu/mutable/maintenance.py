@@ -62,6 +62,7 @@ from raft_tpu.mutable import segments as seg
 # ``compact`` attribute to the function, shadowing the submodule
 from raft_tpu.mutable.compact import (
     COMPACT_RETRY_POLICY,
+    _cleanup_old_generation,
     _clear_stale_wal,
     _note_compaction,
     _publish,
@@ -70,6 +71,7 @@ from raft_tpu.mutable.compact import (
 )
 from raft_tpu.mutable.wal import WriteAheadLog
 from raft_tpu.robust import faults
+from raft_tpu.utils import lockcheck
 from raft_tpu.robust.retry import RetryError, RetryPolicy, retry_call
 
 
@@ -104,14 +106,17 @@ def compact_background(
             # old WAL (durable) and the live delta, and pile up behind
             # the pin for the catch-up below
             faults.fire("compact.merge", generation=new_gen, rows=len(ids))
+            # only _compact_mutex is held here — declared may_block in
+            # lock_order.toml (it serializes whole compactions by
+            # design); writers/searchers contend on _lock, which is free
             index = (
-                seg._build_main(mut.algo, vecs, mut.index_params, mut.metric)  # graft-lint: ignore[blocking-under-lock] — only _compact_mutex is held here, which serializes compactions; writers/searchers contend on _lock, not this
+                seg._build_main(mut.algo, vecs, mut.index_params, mut.metric)
                 if len(ids)
                 else None
             )
             rows_rel = main_rel = None
             if mut.directory is not None:
-                rows_rel, main_rel = _write_generation(  # graft-lint: ignore[blocking-under-lock] — under _compact_mutex only; the writer-facing _lock is free during the artifact write
+                rows_rel, main_rel = _write_generation(
                     mut, new_gen, ids, vecs, index
                 )
             if _mid_rebuild is not None:
@@ -143,8 +148,8 @@ def compact_background(
                         new_wal.append(rec)
                 faults.fire("compact.flip", generation=new_gen)
                 if mut.directory is not None:
-                    _publish(mut, new_gen, rows_rel, main_rel)  # graft-lint: ignore[blocking-under-lock] — the catch-up critical section ends in one fsync'd rename
-                _switch_memory(
+                    _publish(mut, new_gen, rows_rel, main_rel)
+                pending_cleanup = _switch_memory(
                     mut, new_gen, ids, vecs, index, res=res,
                     old_wal_path=old_wal_path, new_wal=new_wal,
                 )
@@ -159,7 +164,11 @@ def compact_background(
                         index=mut.name,
                     )
                 _note_compaction(mut, "background", len(ids), t0)
-                return new_gen
+            # the old generation is unreferenced once the flip landed;
+            # delete it outside _lock so no writer queues behind rmtree
+            if pending_cleanup is not None:
+                _cleanup_old_generation(*pending_cleanup)
+            return new_gen
         finally:
             # on success phase 3 already cleared it; on any failure the
             # index must stop capturing (and drop the backlog copy)
@@ -245,7 +254,12 @@ class Compactor:
         self._seed = int(seed)
         self._poll_interval_s = float(poll_interval_s)
         self._clock = clock
-        self._state_lock = threading.Lock()
+        # leaf lock (lock_order.toml: "compactor.state"): guards only
+        # the pending/busy/thread flags, never held across — nor taken
+        # under — the index locks; the lockcheck witness enforces that
+        self._state_lock = lockcheck.tracked(
+            threading.Lock(), "compactor.state"
+        )
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -43,6 +43,7 @@ from raft_tpu.core import serialize as ser
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.errors import expects
 from raft_tpu.mutable import manifest as man
+from raft_tpu.utils import lockcheck
 from raft_tpu.mutable.wal import WalRecord, WriteAheadLog
 from raft_tpu.ops.distance import DistanceType, is_min_close, resolve_metric
 
@@ -450,12 +451,17 @@ class MutableIndex:
             metric = getattr(index_params, "metric", DistanceType.L2Expanded)
         self.metric = resolve_metric(metric)
         self.name = name or (os.path.basename(directory) if directory else "mutable")
-        self._lock = threading.RLock()
+        self._lock = lockcheck.tracked(threading.RLock(), "mutable.lock")
         # lock ordering: _compact_mutex (if taken) strictly before _lock.
         # It serializes whole compactions (foreground or background) so
         # two rebuilds can never race a generation number, while writers
-        # and searchers keep taking _lock alone.
-        self._compact_mutex = threading.Lock()
+        # and searchers keep taking _lock alone. The full ordering
+        # contract is machine-checked: tools/graft_lint/lock_order.toml
+        # declares it, the lock-order lint derives it statically, and
+        # the RAFT_TPU_LOCKCHECK witness asserts it at runtime.
+        self._compact_mutex = lockcheck.tracked(
+            threading.Lock(), "mutable.compact_mutex"
+        )
         #: when a background compaction is between pin and flip, every
         #: applied mutation is also recorded here so the in-memory
         #: (directory=None) catch-up replay has a source of truth; the

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from raft_tpu.utils import lockcheck
+
 _TRUTHY = ("1", "true", "on", "yes")
 
 _enabled = os.environ.get("RAFT_TPU_OBS", "0").strip().lower() in _TRUTHY
@@ -137,7 +139,10 @@ class Registry:
     this module (:func:`registry`); tests may construct their own."""
 
     def __init__(self, max_spans: int = 200_000):
-        self._lock = threading.RLock()
+        # one shared (tracked) RLock for the registry and every
+        # instrument it hands out; a leaf in lock_order.toml — nothing
+        # may be acquired under it
+        self._lock = lockcheck.tracked(threading.RLock(), "obs.registry")
         self._metrics: Dict[Tuple[str, str, LabelsKey], Any] = {}
         self._spans: List[Dict[str, Any]] = []
         self._t0 = time.perf_counter()

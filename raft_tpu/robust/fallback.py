@@ -17,6 +17,7 @@ import warnings
 
 from raft_tpu import obs
 from raft_tpu.core.errors import KernelFailure
+from raft_tpu.utils import lockcheck
 
 
 def _runtime_error_types():
@@ -46,7 +47,7 @@ def fallback_errors() -> tuple:
 
 
 _warned: set = set()
-_lock = threading.Lock()
+_lock = lockcheck.tracked(threading.Lock(), "robust.fallback")
 
 
 def record_fallback(algo: str, exc: BaseException) -> str:

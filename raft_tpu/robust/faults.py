@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 
 from raft_tpu import obs
 from raft_tpu.core.errors import expects
+from raft_tpu.utils import lockcheck
 
 _TRUTHY = ("1", "true", "on", "yes")
 
@@ -129,7 +130,7 @@ class FaultRegistry:
     """Thread-safe store of installed :class:`FaultSpec` s."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = lockcheck.tracked(threading.RLock(), "robust.faults")
         self._specs: List[FaultSpec] = []
 
     def install(self, spec: FaultSpec) -> FaultSpec:

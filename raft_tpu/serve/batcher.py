@@ -34,6 +34,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from raft_tpu.core.errors import RaftError, expects
+from raft_tpu.utils import lockcheck
 
 
 class QueueFull(RaftError):
@@ -138,7 +139,7 @@ class MicroBatcher:
         import time as _time
 
         self._clock = clock if clock is not None else _time.monotonic
-        self._lock = threading.RLock()
+        self._lock = lockcheck.tracked(threading.RLock(), "serve.batcher")
         # bound documents itself; offer() rejects before append so the
         # maxlen silent-drop semantics can never engage
         self._queue: "deque[Request]" = deque(maxlen=self.capacity)

@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from raft_tpu.core.errors import expects
+from raft_tpu.utils import lockcheck
 
 
 def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
@@ -143,7 +144,9 @@ class ProgramCache:
     def __init__(self, capacity: int = 64):
         expects(capacity >= 1, "capacity must be >= 1, got %d", capacity)
         self.capacity = capacity
-        self._lock = threading.RLock()
+        self._lock = lockcheck.tracked(
+            threading.RLock(), "serve.program_cache"
+        )
         self._programs: "OrderedDict[ProgramKey, Callable]" = OrderedDict()
         self._hits = 0
         self._misses = 0

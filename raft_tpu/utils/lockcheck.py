@@ -1,0 +1,291 @@
+"""Runtime lock-witness: dynamic validation of the lock-order manifest.
+
+The static ``lock-order`` rule (``tools/graft_lint/concurrency_rules``)
+derives lock-acquisition edges from the call graph and checks them
+against ``tools/graft_lint/lock_order.toml``. A static graph can rot —
+an unresolvable callback, a lock taken through a code path the linter
+cannot attribute. This module closes the loop at runtime: tracked locks
+record the acquisition edges **real threads actually take**, and each
+edge is asserted against the same manifest, so the chaos suites
+dynamically validate what the linter claims statically.
+
+Gated by ``RAFT_TPU_LOCKCHECK`` (default **off**), mirroring the
+``RAFT_TPU_OBS`` / ``RAFT_TPU_FAULTS`` switches. Off is zero-cost:
+:func:`tracked` returns the raw lock object untouched, so production
+code pays nothing — not even a wrapper ``__enter__``. On, every tracked
+acquisition walks the thread's held-lock stack and records one
+``(held, acquired)`` edge per distinct held lock (matching how the
+static pass derives edges from *every* transitively held lock).
+
+Because the gate is evaluated when the lock is **created**, enable the
+witness (env var or :func:`enable`) before constructing the objects
+whose locks you want tracked. Module-global locks (the default obs
+registry, the default fault registry) are created at import time, so
+full-coverage runs set ``RAFT_TPU_LOCKCHECK=1`` in the environment
+before the process starts — ``tests/test_lockcheck.py`` drives exactly
+that in a subprocess.
+
+This module deliberately does not import anything from ``tools/`` (the
+runtime package must stand alone); it carries its own minimal TOML
+subset reader for the manifest, with ``tomllib``/``tomli`` preferred
+when importable. A missing manifest degrades to record-only mode:
+edges are still collected (``edges()``), nothing is flagged.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+_enabled = os.environ.get("RAFT_TPU_LOCKCHECK", "0").strip().lower() in _TRUTHY
+
+#: override the manifest location (else: walk up to tools/graft_lint/)
+_MANIFEST_ENV = "RAFT_TPU_LOCKCHECK_MANIFEST"
+
+
+def enable(flag: bool = True) -> None:
+    """Turn the witness on/off for locks created *after* this call."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def disable() -> None:
+    enable(False)
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+# -- manifest ----------------------------------------------------------------
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """The same TOML subset reader the linter falls back to: top-level
+    ``key = value``, ``[[table]]`` sections, string/bool/int/string-array
+    values. Enough for lock_order.toml, dependency-free."""
+    root: dict = {}
+    current = root
+
+    def _value(raw: str):
+        raw = raw.strip()
+        if raw.startswith("["):
+            return [
+                _value(p) for p in raw[1:-1].split(",") if p.strip()
+            ]
+        if raw.startswith('"') and raw.endswith('"'):
+            return raw[1:-1]
+        if raw in ("true", "false"):
+            return raw == "true"
+        try:
+            return int(raw)
+        except ValueError:
+            return raw
+
+    for line in text.splitlines():
+        if "#" in line:
+            line = line.split("#", 1)[0]
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            current = {}
+            root.setdefault(line[2:-2].strip(), []).append(current)
+        elif line.startswith("[") and line.endswith("]"):
+            current = root.setdefault(line[1:-1].strip(), {})
+        elif "=" in line:
+            key, raw = line.split("=", 1)
+            current[key.strip()] = _value(raw)
+    return root
+
+
+def _load_toml(path: str) -> dict:
+    with open(path, "rb") as f:
+        text = f.read().decode("utf-8")
+    try:
+        import tomllib
+    except ImportError:
+        try:
+            import tomli as tomllib  # type: ignore[no-redef]
+        except ImportError:
+            return _parse_toml_subset(text)
+    return tomllib.loads(text)
+
+
+def default_manifest_path() -> Optional[str]:
+    """``tools/graft_lint/lock_order.toml`` found by walking up from
+    this file (repo layout), or the ``RAFT_TPU_LOCKCHECK_MANIFEST``
+    override; None when neither exists (record-only mode)."""
+    override = os.environ.get(_MANIFEST_ENV)
+    if override:
+        return override if os.path.isfile(override) else None
+    d = os.path.dirname(os.path.abspath(__file__))
+    for _ in range(6):
+        cand = os.path.join(d, "tools", "graft_lint", "lock_order.toml")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+class _Manifest:
+    """Declared lock names and permitted edges, as the witness needs
+    them (the static pass owns the richer view)."""
+
+    def __init__(self, data: dict):
+        self.lock_names: Set[str] = {
+            e["name"] for e in data.get("lock", []) if "name" in e
+        }
+        self.edges: Set[Tuple[str, str]] = {
+            (e["from"], e["to"])
+            for e in data.get("edge", [])
+            if "from" in e and "to" in e
+        }
+
+    def permits(self, held: str, acquired: str) -> bool:
+        return held == acquired or (held, acquired) in self.edges
+
+
+_manifest: Optional[_Manifest] = None
+_manifest_loaded = False
+
+
+def manifest() -> Optional[_Manifest]:
+    global _manifest, _manifest_loaded
+    if not _manifest_loaded:
+        _manifest_loaded = True
+        path = default_manifest_path()
+        if path is not None:
+            try:
+                _manifest = _Manifest(_load_toml(path))
+            except (OSError, KeyError, TypeError, ValueError):
+                _manifest = None  # unreadable manifest -> record-only
+    return _manifest
+
+
+# -- the witness -------------------------------------------------------------
+
+_local = threading.local()            # .held: per-thread acquisition stack
+_agg = threading.Lock()               # leaf: guards the aggregates below
+_edges: Dict[Tuple[str, str], int] = {}
+_violations: List[str] = []
+_violation_keys: Set[Tuple[str, str]] = set()
+
+
+def _held_stack() -> List[str]:
+    held = getattr(_local, "held", None)
+    if held is None:
+        held = _local.held = []
+    return held
+
+
+def _note_acquire(name: str) -> None:
+    held = _held_stack()
+    man = manifest()
+    new_edges = {(h, name) for h in held if h != name}
+    if new_edges:
+        with _agg:
+            for edge in new_edges:
+                _edges[edge] = _edges.get(edge, 0) + 1
+                if (
+                    man is not None
+                    and not man.permits(*edge)
+                    and edge not in _violation_keys
+                ):
+                    _violation_keys.add(edge)
+                    _violations.append(
+                        f"{edge[0]} -> {edge[1]} acquired by thread "
+                        f"{threading.current_thread().name!r} is not a "
+                        "permitted edge in lock_order.toml"
+                    )
+    held.append(name)
+
+
+def _note_release(name: str) -> None:
+    held = _held_stack()
+    # locks are almost always released LIFO; tolerate out-of-order by
+    # removing the most recent matching entry
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            return
+
+
+class TrackedLock:
+    """Context-manager/acquire-release wrapper that witnesses one named
+    lock. Delegates to the wrapped primitive, so RLock reentrancy keeps
+    working (a re-acquire records no edge: self-edges are skipped)."""
+
+    __slots__ = ("_lock", "name")
+
+    def __init__(self, lock, name: str):
+        self._lock = lock
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        _note_release(self.name)
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"TrackedLock({self.name!r}, {self._lock!r})"
+
+
+def tracked(lock, name: str):
+    """Wrap ``lock`` for witnessing under its canonical manifest name —
+    or return it untouched when the witness is off (the zero-cost
+    path: no wrapper object, no per-acquire indirection)."""
+    if not _enabled:
+        return lock
+    return TrackedLock(lock, name)
+
+
+# -- reporting ---------------------------------------------------------------
+
+
+def reset() -> None:
+    """Clear recorded edges and violations (held stacks are per-thread
+    and self-balancing; they are not touched)."""
+    with _agg:
+        _edges.clear()
+        _violations.clear()
+        _violation_keys.clear()
+
+
+def edges() -> Dict[Tuple[str, str], int]:
+    """Observed acquisition edges -> times taken."""
+    with _agg:
+        return dict(_edges)
+
+
+def violations() -> List[str]:
+    """Edges observed that the manifest does not permit (one entry per
+    distinct edge)."""
+    with _agg:
+        return list(_violations)
+
+
+def coverage() -> Tuple[Set[Tuple[str, str]], Set[Tuple[str, str]]]:
+    """``(exercised, declared)``: which declared manifest edges the run
+    actually took. ``declared - exercised`` is the untested contract."""
+    man = manifest()
+    declared = set(man.edges) if man is not None else set()
+    with _agg:
+        exercised = declared & set(_edges)
+    return exercised, declared

@@ -47,6 +47,23 @@ def rng():
     return np.random.default_rng(42)
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """When the suite runs under the lock-witness
+    (``RAFT_TPU_LOCKCHECK=1 pytest tests/test_mutable.py tests/test_serve.py``),
+    any manifest-violating acquisition order observed *anywhere* in the
+    run fails the session — the chaos suites double as dynamic
+    validation of ``tools/graft_lint/lock_order.toml``."""
+    from raft_tpu.utils import lockcheck
+
+    if lockcheck.is_enabled() and lockcheck.violations():
+        session.exitstatus = 1
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        if tr is not None:
+            tr.write_line("lock-witness violations:", red=True)
+            for v in lockcheck.violations():
+                tr.write_line("  " + v, red=True)
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

@@ -1,0 +1,18 @@
+"""Seeded violation: a collective under a rank-dependent branch. Only
+rank 0 issues the ``all_gather``; every other rank skips it, so the pod
+hangs at the rendezvous — while a 1-device test (where rank 0 is the
+only rank) passes forever. ``jax.process_index()`` returns a plain
+Python int, so nothing fails at trace time either: this is exactly the
+divergence class only the lint can catch.
+
+Expected: exactly one ``collective-divergence`` on the marked line.
+"""
+import jax
+from jax import lax
+
+
+def broadcast_from_root(x, axis):
+    if jax.process_index() == 0:  # LINT-HERE
+        gathered = lax.all_gather(x, axis_name=axis)
+        return gathered[0]
+    return x

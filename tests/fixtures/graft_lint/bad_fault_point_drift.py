@@ -1,0 +1,14 @@
+"""Seeded violation: a ``FAULT_POINTS`` seam that neither
+``docs/robustness.md`` nor any test mentions — it cannot be used in a
+chaos drill and nothing exercises it.
+
+``wal.append`` is the negative control: documented in the seam catalog
+and driven by the chaos tests, so it must NOT be flagged.
+
+Expected: exactly one ``fault-point-drift`` on the marked line.
+"""
+
+FAULT_POINTS = (
+    "wal.append",
+    "graftlint.fixture.phantom_seam",  # LINT-HERE
+)

@@ -1,0 +1,22 @@
+"""Seeded violation: lock-order inversion. The repo contract
+(``tools/graft_lint/lock_order.toml``, from the segments.py comment) is
+``_compact_mutex`` strictly before ``_lock``; this class nests them the
+other way around, so a thread here and a compaction thread taking the
+declared order deadlock against each other.
+
+Expected: exactly one ``lock-order`` inversion on the marked line.
+"""
+import threading
+
+
+class MutableIndex:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._compact_mutex = threading.Lock()
+        self._generation = 0
+
+    def compact_wrong_order(self):
+        with self._lock:
+            with self._compact_mutex:  # LINT-HERE
+                self._generation += 1
+        return self._generation

@@ -1,0 +1,11 @@
+"""Seeded violation: a metric emitted under a name that
+``docs/observability.md`` does not document — the on-call greps the doc
+table for it and finds nothing.
+
+Expected: exactly one ``metric-drift`` on the marked line.
+"""
+from raft_tpu import obs
+
+
+def record_phantom(n):
+    obs.inc("graftlint.fixture.phantom_metric", count=str(n))  # LINT-HERE

@@ -170,3 +170,62 @@ def hoisted_sync(chunks):
         y = _stage(c)
         ys.append(y)
     return [np.asarray(y) for y in ys]
+
+
+class MutableIndex:
+    # lock-order negative space: the declared order (lock_order.toml) —
+    # _compact_mutex strictly before _lock — resolved via the class name
+    def __init__(self):
+        import threading
+
+        self._lock = threading.RLock()
+        self._compact_mutex = threading.Lock()
+        self._generation = 0
+
+    def compact_declared_order(self):
+        with self._compact_mutex:
+            with self._lock:
+                self._generation += 1
+        return self._generation
+
+
+def mask_by_root(x, root, axis):
+    # collective-divergence negative space: rank-dependent *data* is
+    # fine — every rank issues the same psum; the rank only selects
+    # values inside it
+    r = jax.lax.axis_index(axis)
+    contribution = jnp.where(r == root, x, jnp.zeros_like(x))
+    return jax.lax.psum(contribution, axis)
+
+
+def uniform_shape_branch(x, axis, n):
+    # a branch on axis *size* (or any value every rank agrees on) takes
+    # the same arm on every rank — not divergence
+    if n == 1:
+        return x
+    return jax.lax.psum(x, axis)
+
+
+def symmetric_rank_branch(x, axis):
+    # both arms of a rank-dependent branch issue the same collective
+    # sequence: every rank still reaches one psum — no hang
+    r = jax.lax.axis_index(axis)
+    if r == 0:
+        return jax.lax.psum(x * 2.0, axis)
+    else:
+        return jax.lax.psum(x, axis)
+
+
+def record_dynamic_metric(obs, kind, value):
+    # metric-drift negative space: dynamic names are outside the static
+    # namespace the doc table documents
+    name = f"fixture.{kind}.count"
+    obs.inc(name, value)
+
+
+# fault-point-drift negative space: every seam here is documented in
+# docs/robustness.md and exercised by the chaos tests
+FAULT_POINTS = (
+    "wal.append",
+    "manifest.swap",
+)

@@ -72,6 +72,25 @@ def test_allgather(mesh):
         np.testing.assert_array_equal(got[:, col], np.arange(8, dtype=np.float32))
 
 
+def test_allgather_fault_seam_aborts_trace(mesh):
+    # chaos drill for the comms.all_gather seam: the fault fires at
+    # trace time (verbs run while shard_map traces), so an injected
+    # failure aborts program construction before any collective is
+    # issued — the SPMD analog of a lost participant.
+    from raft_tpu.core.errors import KernelFailure
+    from raft_tpu.robust import faults
+
+    assert "comms.all_gather" in faults.FAULT_POINTS
+    x = jnp.arange(8, dtype=jnp.float32)
+
+    def body(xs):
+        return comms.allgather(xs)
+
+    with faults.injected("comms.all_gather", KernelFailure("chaos")):
+        with pytest.raises(KernelFailure):
+            run_spmd(mesh, body, x, out_specs=P(None, "data"))
+
+
 def test_reducescatter(mesh):
     # comms_test.hpp test_collective_reducescatter: every rank sends ones;
     # each receives sum over ranks of its chunk.

@@ -36,6 +36,10 @@ BAD = {
     "bad_non_atomic_write.py": "non-atomic-write",
     "bad_blocking_under_lock.py": "blocking-under-lock",
     "bad_sync_transfer_in_loop.py": "sync-transfer-in-loop",
+    "bad_lock_order.py": "lock-order",
+    "bad_collective_divergence.py": "collective-divergence",
+    "bad_metric_drift.py": "metric-drift",
+    "bad_fault_point_drift.py": "fault-point-drift",
 }
 
 

@@ -1,0 +1,250 @@
+"""Unit tests for the graft-lint interprocedural layer: call-graph
+construction, import/re-export resolution, cycle-safe fact
+propagation, and the conservative degrade on unknown callees.
+
+Each test builds a tiny package on disk (module names come from the
+filesystem ``__init__.py`` chain) and loads it with
+``core.load_project``.
+"""
+import ast
+import os
+import textwrap
+
+from tools.graft_lint.core import (
+    LintProject,
+    LintModule,
+    load_project,
+    module_name_for_path,
+    walk_executed,
+)
+
+
+def _write_pkg(root, files):
+    """Write ``{relpath: source}`` under ``root``; make every directory
+    on the way a package."""
+    for rel, src in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        d = os.path.dirname(path)
+        while os.path.abspath(d) != os.path.abspath(root):
+            init = os.path.join(d, "__init__.py")
+            if not os.path.exists(init):
+                with open(init, "w", encoding="utf-8") as f:
+                    f.write("")
+            d = os.path.dirname(d)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(textwrap.dedent(src))
+    return load_project([root])
+
+
+def _calls(project, qual):
+    return {t for _, t in project.calls_of(qual) if t is not None}
+
+
+def test_module_name_from_init_chain(tmp_path):
+    _write_pkg(str(tmp_path), {"pkg/sub/mod.py": "x = 1\n"})
+    path = str(tmp_path / "pkg" / "sub" / "mod.py")
+    assert module_name_for_path(path) == "pkg.sub.mod"
+    init = str(tmp_path / "pkg" / "sub" / "__init__.py")
+    assert module_name_for_path(init) == "pkg.sub"
+
+
+def test_cross_module_resolution(tmp_path):
+    project = _write_pkg(str(tmp_path), {
+        "pkg/a.py": """\
+            def helper():
+                return 1
+            """,
+        "pkg/b.py": """\
+            from pkg.a import helper
+            from pkg import a
+
+            def caller():
+                return helper()
+
+            def qualified_caller():
+                return a.helper()
+            """,
+    })
+    assert _calls(project, "pkg.b.caller") == {"pkg.a.helper"}
+    assert _calls(project, "pkg.b.qualified_caller") == {"pkg.a.helper"}
+
+
+def test_reexport_through_package_init(tmp_path):
+    project = _write_pkg(str(tmp_path), {
+        "pkg/a.py": """\
+            def helper():
+                return 1
+            """,
+        "pkg/__init__.py": "from pkg.a import helper\n",
+        "pkg/b.py": """\
+            from pkg import helper
+
+            def caller():
+                return helper()
+            """,
+    })
+    assert _calls(project, "pkg.b.caller") == {"pkg.a.helper"}
+
+
+def test_method_resolution_via_self_and_annotation(tmp_path):
+    project = _write_pkg(str(tmp_path), {
+        "pkg/a.py": """\
+            class Store:
+                def save(self):
+                    return 1
+
+                def flush(self):
+                    return self.save()
+
+            def drain(store: "Store"):
+                return store.save()
+            """,
+    })
+    assert _calls(project, "pkg.a.Store.flush") == {"pkg.a.Store.save"}
+    assert _calls(project, "pkg.a.drain") == {"pkg.a.Store.save"}
+
+
+def test_unknown_callee_degrades_to_unresolved(tmp_path):
+    project = _write_pkg(str(tmp_path), {
+        "pkg/a.py": """\
+            import os
+
+            def caller(cb):
+                cb()                 # callback value: untracked
+                os.getcwd()          # stdlib: not in the project
+                return undefined()   # noqa: F821 — nowhere at all
+            """,
+    })
+    assert _calls(project, "pkg.a.caller") == set()
+    # and every call is still *recorded*, just unresolved
+    assert len(project.calls_of("pkg.a.caller")) == 3
+
+
+def test_recursion_and_cycles_converge(tmp_path):
+    project = _write_pkg(str(tmp_path), {
+        "pkg/a.py": """\
+            import time
+
+            def f():
+                return g()
+
+            def g():
+                f()
+                time.sleep(0.1)
+            """,
+    })
+    facts = project.blocking_facts()
+    # both members of the cycle carry the sleep fact exactly once
+    assert ("pkg.a.g", "sleep") in facts["pkg.a.f"]
+    assert ("pkg.a.g", "sleep") in facts["pkg.a.g"]
+    line, path = facts["pkg.a.f"][("pkg.a.g", "sleep")]
+    assert path == ["pkg.a.g"]
+    assert line == facts["pkg.a.g"][("pkg.a.g", "sleep")][0]
+
+
+def test_transitive_blocking_facts_record_call_path(tmp_path):
+    project = _write_pkg(str(tmp_path), {
+        "pkg/a.py": """\
+            import shutil
+
+            def leaf(d):
+                shutil.rmtree(d)
+            """,
+        "pkg/b.py": """\
+            from pkg.a import leaf
+
+            def mid(d):
+                leaf(d)
+
+            def top(d):
+                mid(d)
+            """,
+    })
+    facts = project.blocking_facts()
+    key = ("pkg.a.leaf", "rmtree")
+    assert key in facts["pkg.a.leaf"] and facts["pkg.a.leaf"][key][1] == []
+    assert facts["pkg.b.mid"][key][1] == ["pkg.a.leaf"]
+    assert facts["pkg.b.top"][key][1] == ["pkg.b.mid", "pkg.a.leaf"]
+
+
+def test_collective_facts_propagate(tmp_path):
+    project = _write_pkg(str(tmp_path), {
+        "pkg/a.py": """\
+            from jax import lax
+
+            def gather(x, axis):
+                return lax.all_gather(x, axis)
+
+            def wrapper(x, axis):
+                return gather(x, axis)
+
+            def quiet(x):
+                return x + 1
+            """,
+    })
+    facts = project.collective_facts()
+    assert "all_gather" in facts["pkg.a.gather"]
+    assert facts["pkg.a.wrapper"]["all_gather"][1] == ["pkg.a.gather"]
+    assert facts["pkg.a.quiet"] == {}
+
+
+def test_nested_defs_are_deferred_code(tmp_path):
+    # a blocking call inside a nested def does not execute at the point
+    # of definition, so the enclosing function must NOT inherit the fact
+    project = _write_pkg(str(tmp_path), {
+        "pkg/a.py": """\
+            import time
+
+            def outer():
+                def attempt():
+                    time.sleep(1.0)
+                return attempt
+            """,
+    })
+    facts = project.blocking_facts()
+    assert facts["pkg.a.outer"] == {}
+
+
+def test_walk_executed_skips_nested_bodies():
+    tree = ast.parse(
+        "def outer():\n"
+        "    x = 1\n"
+        "    def inner():\n"
+        "        y = 2\n"
+        "    z = 3\n"
+    )
+    fn = tree.body[0]
+    names = {
+        n.id for n in walk_executed(fn.body)
+        if isinstance(n, ast.Name)
+    }
+    assert "x" in names and "z" in names and "y" not in names
+
+
+def test_unparseable_module_is_dropped_not_fatal(tmp_path):
+    project = _write_pkg(str(tmp_path), {
+        "pkg/a.py": """\
+            def helper():
+                return 1
+            """,
+    })
+    broken = tmp_path / "pkg" / "broken.py"
+    broken.write_text("def f(:\n")
+    project = load_project([str(tmp_path)])
+    assert "pkg.a.helper" in project.functions
+    assert "pkg.broken" not in project.by_name
+
+
+def test_single_module_project_via_lint_module():
+    src = (
+        "import time\n"
+        "def slow():\n"
+        "    time.sleep(1)\n"
+        "def wrapper():\n"
+        "    slow()\n"
+    )
+    module = LintModule("solo.py", src)
+    project = LintProject([module])
+    facts = project.blocking_facts()
+    assert ("solo.slow", "sleep") in facts["solo.wrapper"]

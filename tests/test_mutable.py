@@ -1079,3 +1079,91 @@ class TestManifest:
         got = man.read(d)
         assert got is not None and got.generation == 1  # old pointer intact
         assert not [p for p in os.listdir(d) if p.endswith(".tmp%d" % os.getpid())]
+
+
+class TestCleanupOffLock:
+    """Superseded-generation deletion must run *after* the index lock is
+    released: rmtree + WAL unlinks are corpus-proportional filesystem
+    work, and holding ``_lock`` across them stalls every writer and
+    searcher (the bug the interprocedural ``blocking-under-lock`` rule
+    found at its first run over the tree). ``_switch_memory`` therefore
+    returns the cleanup arguments instead of deleting inline; these
+    tests pin that contract for both compaction paths."""
+
+    def _probe_lock_free(self, mut, witness):
+        """Called while cleanup runs: from another thread, the index
+        lock must be acquirable (RLock reentrancy makes a same-thread
+        probe vacuous, so the probe *must* cross threads)."""
+        got = []
+
+        def probe():
+            ok = mut._lock.acquire(timeout=2.0)
+            got.append(ok)
+            if ok:
+                mut._lock.release()
+
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+        witness.append(bool(got and got[0]))
+
+    @pytest.mark.parametrize("path", ["sync", "background"])
+    def test_old_generation_deleted_off_lock(self, rng, tmp_path, monkeypatch, path):
+        import importlib
+
+        # the package re-exports the compact *function*, which shadows
+        # the submodule attribute — go through importlib
+        compact_mod = importlib.import_module("raft_tpu.mutable.compact")
+        maint_mod = importlib.import_module("raft_tpu.mutable.maintenance")
+        from raft_tpu.mutable import segments as seg
+
+        d = str(tmp_path / "idx")
+        mut = MutableIndex.open(d, "brute_force", DIM)
+        mut.insert(_rows(rng, 48))
+        mut.compact()  # generation 1 on disk
+        mut.insert(_rows(rng, 8))
+        old_dir = os.path.join(d, seg._gen_dirname(mut.generation))
+        assert os.path.isdir(old_dir)
+
+        lock_free_during_cleanup = []
+        calls = []
+        real = compact_mod._cleanup_old_generation
+
+        def spy(directory, old_gen, old_wal_path):
+            self._probe_lock_free(mut, lock_free_during_cleanup)
+            calls.append((directory, old_gen))
+            real(directory, old_gen, old_wal_path)
+
+        # each caller binds the helper into its own namespace
+        monkeypatch.setattr(compact_mod, "_cleanup_old_generation", spy)
+        monkeypatch.setattr(maint_mod, "_cleanup_old_generation", spy)
+
+        gen = mut.compact() if path == "sync" else mut.compact_background()
+        assert calls == [(d, gen - 1)]
+        assert lock_free_during_cleanup == [True], (
+            "cleanup ran while the index lock was held — writers and "
+            "searchers were stalled behind corpus-proportional rmtree"
+        )
+        assert not os.path.isdir(old_dir), "old generation must still be deleted"
+        mut.close()
+
+    def test_switch_memory_returns_cleanup_args_not_side_effects(self, rng, tmp_path):
+        # the in-memory flip itself must never delete anything: it hands
+        # the cleanup triple back to the caller
+        from raft_tpu.mutable import segments as seg
+
+        d = str(tmp_path / "idx")
+        mut = MutableIndex.open(d, "brute_force", DIM)
+        mut.insert(_rows(rng, 16))
+        gen_before = mut.generation
+        mut.compact()
+        # in-memory-only index: nothing on disk to clean, returns None
+        mem = MutableIndex("brute_force", DIM)
+        mem.insert(_rows(rng, 4))
+        from raft_tpu.mutable.compact import _switch_memory
+
+        ids, vecs = mem.live_rows()
+        with mem._lock:
+            assert _switch_memory(mem, mem.generation + 1, ids, vecs, None) is None
+        assert os.path.isdir(os.path.join(d, seg._gen_dirname(gen_before + 1)))
+        mut.close()

@@ -1,6 +1,11 @@
 """CLI: ``python -m tools.graft_lint [paths...]``.
 
 Exit status: 0 = clean, 1 = violations found, 2 = usage error.
+
+``--graph`` skips linting and instead dumps the interprocedural view
+the rules run on — the derived lock-acquisition edges (with one call
+path witnessing each) and a call-graph summary — as JSON, for
+debugging a surprising lock-order or blocking-under-lock finding.
 """
 from __future__ import annotations
 
@@ -8,7 +13,57 @@ import argparse
 import json
 import sys
 
-from tools.graft_lint.core import all_checkers, run_lint
+from tools.graft_lint.core import all_checkers, load_project, run_lint
+
+
+def _graph_dump(paths) -> dict:
+    """The derived graphs as a JSON-ready dict: every resolved call
+    edge, and every lock-acquisition fact (function -> lock it may
+    acquire, with the call path that witnesses it)."""
+    from tools.graft_lint import lockmanifest
+    from tools.graft_lint.concurrency_rules import acquired_lock_facts
+
+    project = load_project(paths)
+    calls = {}
+    for qual in project.functions:
+        targets = sorted(
+            {t for _, t in project.calls_of(qual) if t is not None}
+        )
+        if targets:
+            calls[qual] = targets
+    out = {
+        "modules": sorted(m.module_name for m in project.modules),
+        "functions": len(project.functions),
+        "call_edges": calls,
+    }
+    manifest = lockmanifest.load_manifest()
+    if manifest is not None:
+        locks = {}
+        lock_edges = set()
+        for qual, facts in acquired_lock_facts(project, manifest).items():
+            if facts:
+                locks[qual] = {
+                    name: {"line": ln, "via": path}
+                    for name, (ln, path) in sorted(facts.items())
+                }
+        # held -> acquired pairs actually derivable from nesting: the
+        # static analog of what the runtime witness records
+        from tools.graft_lint.concurrency_rules import LockOrderChecker
+
+        checker = LockOrderChecker()
+        derived = []
+        for module in project.modules:
+            for v in checker.check(module):
+                derived.append(v.render())
+        out["lock_order"] = {
+            "manifest": manifest.path,
+            "declared_edges": sorted(
+                f"{a} -> {b}" for (a, b) in manifest.edges
+            ),
+            "acquires": locks,
+            "violations": derived,
+        }
+    return out
 
 
 def main(argv=None) -> int:
@@ -34,11 +89,20 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="list rule ids and exit"
     )
+    parser.add_argument(
+        "--graph", action="store_true",
+        help="dump the derived call graph and lock-order facts as JSON "
+             "instead of linting",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for c in all_checkers():
             print(f"{c.rule:16s} {c.doc}")
+        return 0
+
+    if args.graph:
+        print(json.dumps(_graph_dump(args.paths), indent=2, sort_keys=True))
         return 0
 
     try:

@@ -13,13 +13,35 @@ easy to reintroduce:
   The intentional gather sites — the parity reference engine and the
   ring's fallback target — carry a rationale'd
   ``# graft-lint: ignore[gather-merge]``.
+
+* ``collective-divergence`` — a collective (``psum``/``ppermute``/
+  ``all_gather``/…) issued under a branch that depends on the rank
+  (``axis_index``/``process_index``), or a rank-dependent branch whose
+  two arms issue *different* collective sequences, or a rank-dependent
+  early exit with collectives after it. Collectives are rendezvous
+  points: every rank in the axis must reach the same sequence or the
+  pod hangs — and nothing catches it on one device, where rank 0 is
+  the only rank and every branch agrees with itself. (Branching on a
+  *traced* value fails loudly at trace time —
+  ``ConcretizationTypeError`` — so the silent killer this rule hunts
+  is specifically the rank-dependent Python branch, which traces
+  fine.) Rank-dependent *data* is fine: ``jnp.where(rank == root, …)``
+  masks values uniformly on every rank; it is rank-dependent *control
+  flow* around a collective that diverges. Collectives reached through
+  calls count too, via the project call graph.
 """
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import Iterator, Optional, Set, Tuple
 
-from tools.graft_lint.core import Checker, LintModule, Violation
+from tools.graft_lint.core import (
+    COLLECTIVE_PRIMITIVES,
+    Checker,
+    LintModule,
+    Violation,
+    walk_executed,
+)
 
 #: call names that consume a gathered candidate set as a merge/top-k
 _MERGE_CALLS = frozenset(
@@ -78,4 +100,156 @@ class GatherMergeChecker(Checker):
                 )
 
 
-CHECKERS = [GatherMergeChecker()]
+#: calls whose result identifies "which rank am I" — the taint seeds.
+#: Deliberately NOT axis-size (`psum(1)`, `axis_size`): `if n == 1:`
+#: shape-specialization branches are uniform across the axis.
+_RANK_SOURCES = frozenset({"axis_index", "process_index", "comm_rank"})
+
+
+def _is_rank_source(node: ast.expr) -> bool:
+    return isinstance(node, ast.Call) and _attr_name(node) in _RANK_SOURCES
+
+
+class CollectiveDivergenceChecker(Checker):
+    rule = "collective-divergence"
+    doc = (
+        "collective op under a rank-dependent branch, or rank-dependent "
+        "branch arms issuing different collective sequences — ranks "
+        "stop agreeing on the rendezvous order and the pod hangs; "
+        "passes every 1-device test"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        project = getattr(module, "project", None)
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            info = project.function_at(module, fn) if project is not None else None
+            tainted = self._taint_set(fn)
+            yield from self._scan_block(module, project, info, fn.body, tainted)
+
+    # -- taint --------------------------------------------------------------
+
+    def _taint_set(self, fn) -> Set[str]:
+        """Names in ``fn`` holding rank-derived values: seeded by
+        ``axis_index()``-family calls, closed over simple assignments
+        (``is_root = rank == 0`` taints ``is_root``)."""
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in walk_executed(fn.body):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    continue
+                value = node.value
+                if value is None or not self._expr_tainted(value, tainted):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for tgt in targets:
+                    for leaf in ast.walk(tgt):
+                        if isinstance(leaf, ast.Name) and leaf.id not in tainted:
+                            tainted.add(leaf.id)
+                            changed = True
+        return tainted
+
+    def _expr_tainted(self, expr: ast.expr, tainted: Set[str]) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+            if _is_rank_source(sub):
+                return True
+        return False
+
+    # -- footprints ---------------------------------------------------------
+
+    def _footprint(self, project, info, stmts) -> Tuple[str, ...]:
+        """Sorted collective sequence a statement list may issue: direct
+        calls with multiplicity plus (transitively, via the call graph)
+        collectives of resolved callees."""
+        out = []
+        trans: Set[str] = set()
+        for node in walk_executed(stmts):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _attr_name(node)
+            if name in COLLECTIVE_PRIMITIVES:
+                out.append(name)
+            elif project is not None and info is not None:
+                target = project.resolve_call(info, node)
+                if target is not None:
+                    trans.update(project.collective_facts().get(target, {}))
+        return tuple(sorted(out) + sorted(trans - set(out)))
+
+    @staticmethod
+    def _exits(stmts) -> bool:
+        return any(
+            isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+            for s in stmts
+        )
+
+    # -- scan ---------------------------------------------------------------
+
+    def _scan_block(self, module, project, info, stmts, tainted):
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, ast.If) and self._expr_tainted(stmt.test, tainted):
+                body_fp = self._footprint(project, info, stmt.body)
+                else_fp = self._footprint(project, info, stmt.orelse)
+                if body_fp != else_fp:
+                    diff = sorted(set(body_fp) ^ set(else_fp)) or sorted(set(body_fp))
+                    yield self.violation(
+                        module, stmt,
+                        "branch on a rank-dependent value issues different "
+                        f"collective sequences per arm ({', '.join(diff)}) "
+                        "— ranks taking different arms stop agreeing on "
+                        "the rendezvous order and the pod hangs; issue the "
+                        "same collectives on every rank and select results "
+                        "with jnp.where(rank == ..., ...)",
+                    )
+                elif (
+                    self._exits(stmt.body) != self._exits(stmt.orelse)
+                    and self._footprint(project, info, stmts[i + 1:])
+                ):
+                    yield self.violation(
+                        module, stmt,
+                        "rank-dependent early exit skips the collectives "
+                        "issued after this branch on some ranks — the "
+                        "remaining ranks block forever at the rendezvous; "
+                        "every rank must run the same collective sequence",
+                    )
+            elif isinstance(stmt, ast.While) and self._expr_tainted(stmt.test, tainted):
+                fp = self._footprint(project, info, stmt.body)
+                if fp:
+                    yield self.violation(
+                        module, stmt,
+                        "while-loop with a rank-dependent condition issues "
+                        f"collectives ({', '.join(sorted(set(fp)))}) — "
+                        "ranks run different trip counts and desynchronize "
+                        "at the rendezvous; hoist the collective or make "
+                        "the trip count uniform",
+                    )
+            elif isinstance(stmt, ast.For) and self._expr_tainted(stmt.iter, tainted):
+                fp = self._footprint(project, info, stmt.body)
+                if fp:
+                    yield self.violation(
+                        module, stmt,
+                        "for-loop over a rank-dependent range issues "
+                        f"collectives ({', '.join(sorted(set(fp)))}) — "
+                        "trip counts differ per rank and the pod hangs at "
+                        "the first unmatched rendezvous; loop bounds must "
+                        "be uniform across the axis",
+                    )
+            # recurse into nested statement bodies (skip nested defs —
+            # they are checked as their own functions)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub and not isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield from self._scan_block(module, project, info, sub, tainted)
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from self._scan_block(
+                    module, project, info, handler.body, tainted
+                )
+
+
+CHECKERS = [GatherMergeChecker(), CollectiveDivergenceChecker()]

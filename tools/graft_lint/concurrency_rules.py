@@ -1,0 +1,259 @@
+"""Concurrency checkers: the lock-order rule.
+
+The serving stack synchronizes through a handful of locks whose
+ordering contract is declared in ``tools/graft_lint/lock_order.toml``
+(see :mod:`tools.graft_lint.lockmanifest`). This module derives the
+*actual* acquisition-edge set — lock held → lock acquired, looking
+through calls via the project call graph — and reports:
+
+* ``lock-order`` / undeclared lock: a lock-like ``with`` inside the
+  scanned packages that no ``[[lock]]`` declaration matches. An
+  undeclared lock is exactly how ``Compactor._state_lock`` drifted out
+  of the documented ordering — declare it, with its position.
+* ``lock-order`` / inversion: an observed edge whose *reverse* is
+  declared. Two threads taking the two orders deadlock; this is the
+  classic AB/BA.
+* ``lock-order`` / undeclared edge: an observed edge the manifest does
+  not permit. Either the code is wrong or the contract is incomplete —
+  both need a human: declare the edge with a rationale or reorder the
+  code.
+* ``lock-order`` / manifest cycle: the declared edge set itself
+  contains a cycle — the manifest licenses a deadlock.
+
+Edges are derived both from lexically nested ``with`` blocks and from
+calls made while a lock is held whose callees (transitively) acquire
+locks. Calls the graph cannot resolve contribute nothing — an unknown
+callee degrades coverage, never correctness of what *is* reported. The
+runtime witness (:mod:`raft_tpu.utils.lockcheck`) closes that gap
+dynamically under the chaos suites.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.graft_lint import lockmanifest
+from tools.graft_lint.core import (
+    Checker,
+    FunctionInfo,
+    LintModule,
+    LintProject,
+    Violation,
+    walk_executed,
+)
+
+#: substrings of a ``with`` context-expression name that mark it as a
+#: lock acquisition (kept in sync with robust_rules._LOCK_HINTS)
+_LOCK_HINTS = ("lock", "mutex")
+
+
+def _context_attr(expr: ast.expr) -> Optional[str]:
+    """Rightmost name of a with-context expression (``mut._lock`` ->
+    "_lock"), unwrapping a call (``lock.acquire()`` shapes)."""
+    while isinstance(expr, ast.Call):
+        expr = expr.func
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_lock_like(expr: ast.expr) -> bool:
+    name = _context_attr(expr)
+    return name is not None and any(h in name.lower() for h in _LOCK_HINTS)
+
+
+def resolve_lock(
+    project: Optional[LintProject],
+    manifest: "lockmanifest.LockManifest",
+    module: LintModule,
+    info: Optional[FunctionInfo],
+    expr: ast.expr,
+):
+    """The manifest :class:`~tools.graft_lint.lockmanifest.LockDecl` a
+    with-context expression acquires, or None. Class context comes from
+    ``self`` or from the receiver's inferred type (``mut: MutableIndex``
+    → class MutableIndex)."""
+    attr = _context_attr(expr)
+    if attr is None:
+        return None
+    class_name = None
+    base = expr
+    while isinstance(base, ast.Call):
+        base = base.func
+    if isinstance(base, ast.Attribute) and project is not None and info is not None:
+        recv = project.infer_type(info, base.value)
+        if recv is not None:
+            class_name = recv.rsplit(".", 1)[-1]
+    return manifest.resolve(attr, class_name, module.path)
+
+
+def acquired_lock_facts(
+    project: LintProject, manifest: "lockmanifest.LockManifest"
+) -> Dict[str, Dict]:
+    """function qual -> {canonical lock name: (line, call_path)} —
+    which declared locks a function may acquire, directly or through
+    calls. Cached on the project."""
+    key = ("locks", manifest.path)
+    if key not in project._fact_cache:
+        def direct(info: FunctionInfo):
+            out = {}
+            for node in walk_executed(info.node.body):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        decl = resolve_lock(
+                            project, manifest, info.module, info, item.context_expr
+                        )
+                        if decl is not None and decl.name not in out:
+                            out[decl.name] = node.lineno
+            return out
+        project._fact_cache[key] = project.propagate(direct)
+    return project._fact_cache[key]
+
+
+class LockOrderChecker(Checker):
+    rule = "lock-order"
+    doc = (
+        "lock acquisition (direct or through calls) that inverts or "
+        "escapes the declared ordering manifest lock_order.toml, or a "
+        "lock the manifest does not know — potential deadlock or "
+        "contract drift"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        manifest = lockmanifest.load_manifest()
+        if manifest is None:
+            return
+        project = module.project
+        # manifest self-check: report declared cycles once per project
+        if project is not None and not getattr(project, "_lock_cycles_done", False):
+            project._lock_cycles_done = True
+            for cyc in manifest.declared_cycles():
+                yield Violation(
+                    rule=self.rule, path=module.path, line=1, col=1,
+                    message=(
+                        "lock_order.toml declares a cyclic order "
+                        f"({' -> '.join(cyc)}) — the manifest itself "
+                        "licenses a deadlock; break the cycle"
+                    ),
+                )
+        self._seen: set = set()
+        handled: set = set()
+        if project is not None:
+            acquired = acquired_lock_facts(project, manifest)
+            for info in project.functions.values():
+                if info.module is not module:
+                    continue
+                for node in walk_executed(info.node.body):
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        if id(node) in handled:
+                            continue
+                        yield from self._scan_with(
+                            project, manifest, module, info, acquired,
+                            node, [], handled,
+                        )
+        # module-level / nested-def withs the function index missed:
+        # still check for undeclared locks (no receiver typing)
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)) and id(node) not in handled:
+                handled.add(id(node))
+                for item in node.items:
+                    yield from self._check_item(
+                        manifest, module, None, item, node, []
+                    )
+
+    def _check_item(self, manifest, module, decl, item, node, held):
+        """Violations for one with-item given its resolved decl (or
+        None): undeclared-lock and bad direct edges."""
+        if decl is None:
+            if _is_lock_like(item.context_expr) and manifest.in_scanned_scope(module.path):
+                attr = _context_attr(item.context_expr)
+                key = ("undeclared", node.lineno, attr)
+                if key not in self._seen:
+                    self._seen.add(key)
+                    yield Violation(
+                        rule=self.rule, path=module.path, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"'{attr}' looks like a lock but no [[lock]] in "
+                            "lock_order.toml matches it — declare it (canonical "
+                            "name, class, path) so its ordering is checkable"
+                        ),
+                    )
+            return
+        for h in held:
+            yield from self._edge(manifest, module, node, h, decl.name, [])
+
+    def _edge(self, manifest, module, node, held, acquired, chain):
+        if manifest.permits(held, acquired):
+            return
+        key = ("edge", node.lineno, held, acquired)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        via = f" (via {' -> '.join(chain)})" if chain else ""
+        if (acquired, held) in manifest.edges:
+            yield Violation(
+                rule=self.rule, path=module.path, line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"acquiring {acquired} while holding {held} INVERTS the "
+                    f"declared edge {acquired} -> {held}{via} — two threads "
+                    "taking both orders deadlock; reorder to match "
+                    "lock_order.toml"
+                ),
+            )
+        else:
+            yield Violation(
+                rule=self.rule, path=module.path, line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"acquisition edge {held} -> {acquired}{via} is not "
+                    "permitted by lock_order.toml — declare it with a "
+                    "rationale or restructure so the lock is not held here"
+                ),
+            )
+
+    def _scan_with(
+        self, project, manifest, module, info, acquired, node, held, handled
+    ):
+        """Recursive scan of a with-statement: check its items against
+        the held set, then its body with the item locks added."""
+        handled.add(id(node))
+        new_held = list(held)
+        for item in node.items:
+            decl = resolve_lock(project, manifest, module, info, item.context_expr)
+            yield from self._check_item(manifest, module, decl, item, node, new_held)
+            if decl is not None:
+                new_held.append(decl.name)
+        yield from self._scan_body(
+            project, manifest, module, info, acquired, node.body, new_held, handled
+        )
+
+    def _scan_body(
+        self, project, manifest, module, info, acquired, stmts, held, handled
+    ):
+        stack = list(stmts)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                yield from self._scan_with(
+                    project, manifest, module, info, acquired, node, held, handled
+                )
+                continue
+            if isinstance(node, ast.Call) and held:
+                target = project.resolve_call(info, node)
+                if target is not None:
+                    for lock_name, (_ln, path) in acquired.get(target, {}).items():
+                        for h in held:
+                            yield from self._edge(
+                                manifest, module, node, h, lock_name,
+                                [target] + path,
+                            )
+            stack.extend(ast.iter_child_nodes(node))
+
+
+CHECKERS = [LockOrderChecker()]

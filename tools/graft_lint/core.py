@@ -34,7 +34,7 @@ import io
 import os
 import re
 import tokenize
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graft-lint\s*:\s*ignore(?:\[(?P<rules>[\w\-, ]+)\])?"
@@ -87,6 +87,10 @@ class LintModule:
         self.skip_file = False
         # line -> set of suppressed rule ids; "*" suppresses every rule
         self.suppressions: Dict[int, Set[str]] = {}
+        #: whole-program view this module was linted under (set by
+        #: LintProject); single-file lint_source builds a one-module
+        #: project, so checkers can always rely on it
+        self.project: Optional["LintProject"] = None
         self._scan_comments()
 
     def _scan_comments(self) -> None:
@@ -115,15 +119,533 @@ class LintModule:
         return "*" in ids or v.rule in ids
 
 
+# ---------------------------------------------------------------------------
+# Interprocedural layer: a module-resolving call graph over every linted
+# file, plus fact propagation ("may block", "may issue a collective",
+# "acquires lock L") so checkers can see through calls. Resolution is
+# deliberately conservative: a call that cannot be attributed to exactly
+# one parsed function stays unresolved, and propagation simply does not
+# flow through it — an unknown callee degrades the analysis, never
+# crashes it.
+# ---------------------------------------------------------------------------
+
+#: call names that block for corpus-proportional (build/save/compile) or
+#: device-roundtrip time — the *direct* seeds of the may-block fact
+BLOCKING_PRIMITIVES = frozenset(
+    {
+        # index builds / model fits
+        "build", "rebuild", "fit", "_build_main",
+        # artifact writes and durability loops
+        "atomic_write", "save_path", "save_stream", "_save_rows",
+        "_save_main", "_write_generation", "fsync",
+        # corpus-proportional filesystem work
+        "rmtree",
+        # the manifest flip and its wrapper
+        "swap", "_publish",
+        # device synchronization / transfer
+        "block_until_ready", "device_put",
+        # host sleeps (retry backoff, injected latency)
+        "sleep",
+    }
+)
+
+#: SPMD collective verbs — every rank in the axis must reach the call
+#: the same number of times in the same order or the pod hangs
+COLLECTIVE_PRIMITIVES = frozenset(
+    {
+        "psum", "pmax", "pmin", "pmean", "psum_scatter", "ppermute",
+        "all_gather", "all_to_all", "pshuffle",
+    }
+)
+
+
+def module_name_for_path(path: str) -> str:
+    """Dotted module name derived from the filesystem: walk up while the
+    parent directory is a package (has ``__init__.py``). A stray file
+    outside any package is just its stem."""
+    path = os.path.abspath(path)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if stem == "__init__" else [stem]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    return ".".join(parts) or stem
+
+
+def _last_name(expr: ast.expr) -> Optional[str]:
+    """Rightmost name of an expression (``a.b.c`` -> "c")."""
+    while isinstance(expr, ast.Call):
+        expr = expr.func
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One parsed function/method in the project."""
+
+    qual: str                      # "pkg.mod.Class.meth" / "pkg.mod.fn"
+    module: "LintModule"
+    node: ast.AST                  # FunctionDef / AsyncFunctionDef
+    cls: Optional[str] = None      # enclosing class name, if a method
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    qual: str                      # "pkg.mod.Class"
+    name: str
+    module: "LintModule"
+    bases: List[str] = dataclasses.field(default_factory=list)
+    methods: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # attribute name -> annotation/constructor expr its type came from
+    attr_types: Dict[str, ast.expr] = dataclasses.field(default_factory=dict)
+
+
+class LintProject:
+    """Whole-program view over a set of :class:`LintModule` s: symbol
+    tables, import resolution (including package ``__init__``
+    re-exports), a call graph, and cycle-safe fact propagation.
+
+    Known limits (documented in ``docs/static_analysis.md``): callables
+    passed as values (callbacks, ``retry_call(fn)``) are not tracked;
+    receiver types come from ``self``, parameter annotations (string
+    annotations and ``Optional[...]`` included), local ``x = Cls(...)``
+    assignments, class-body ``self.x`` assignments, and module-global
+    instances — anything else leaves the call unresolved.
+    """
+
+    def __init__(self, modules: Sequence["LintModule"]):
+        self.modules = list(modules)
+        self.by_name: Dict[str, LintModule] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, _ClassInfo] = {}
+        self._mod_classes: Dict[str, Dict[str, _ClassInfo]] = {}
+        self._mod_functions: Dict[str, Dict[str, str]] = {}
+        self._mod_imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        self._mod_instances: Dict[str, Dict[str, ast.expr]] = {}
+        self._calls: Dict[str, List[Tuple[ast.Call, Optional[str]]]] = {}
+        self._fact_cache: Dict[str, Dict] = {}
+        self._by_node: Dict[int, FunctionInfo] = {}
+        for m in self.modules:
+            m.project = self
+            m.module_name = module_name_for_path(m.path)
+            self.by_name.setdefault(m.module_name, m)
+        for m in self.modules:
+            try:
+                self._index_module(m)
+            except Exception:  # graft-lint: ignore[silent-except] — a weird module degrades to "unresolved", never a lint crash
+                pass
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index_module(self, m: "LintModule") -> None:
+        mod = m.module_name
+        classes: Dict[str, _ClassInfo] = {}
+        funcs: Dict[str, str] = {}
+        imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        instances: Dict[str, ast.expr] = {}
+        for node in m.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._index_import(mod, node, imports)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{mod}.{node.name}"
+                funcs[node.name] = qual
+                self.functions[qual] = FunctionInfo(qual, m, node)
+                self._by_node[id(node)] = self.functions[qual]
+            elif isinstance(node, ast.ClassDef):
+                ci = _ClassInfo(
+                    qual=f"{mod}.{node.name}", name=node.name, module=m,
+                    bases=[b for b in (_last_name(x) for x in node.bases) if b],
+                )
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        mq = f"{mod}.{node.name}.{sub.name}"
+                        ci.methods[sub.name] = mq
+                        self.functions[mq] = FunctionInfo(mq, m, sub, cls=node.name)
+                        self._by_node[id(sub)] = self.functions[mq]
+                        self._scan_self_attrs(sub, ci)
+                classes[node.name] = ci
+                self.classes[ci.qual] = ci
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and isinstance(node.value, ast.Call):
+                    instances[t.id] = node.value.func
+            # also index imports/defs nested one level down (e.g. inside
+            # ``if TYPE_CHECKING:``) — common enough to matter
+            if isinstance(node, (ast.If, ast.Try)):
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                        self._index_import(mod, sub, imports)
+        self._mod_classes[mod] = classes
+        self._mod_functions[mod] = funcs
+        self._mod_imports[mod] = imports
+        self._mod_instances[mod] = instances
+
+    def _index_import(self, mod, node, imports) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0], None,
+                )
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:  # relative: resolve against this package
+                pkg = mod.split(".")
+                pkg = pkg[: len(pkg) - node.level]
+                base = ".".join(pkg + ([node.module] if node.module else []))
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imports[a.asname or a.name] = (base, a.name)
+
+    def _scan_self_attrs(self, fn: ast.AST, ci: _ClassInfo) -> None:
+        """Record ``self.x: T = ...`` / ``self.x = Cls(...)`` attribute
+        types from method bodies (``__init__`` mostly)."""
+        for node in ast.walk(fn):
+            target = value = None
+            if isinstance(node, ast.AnnAssign) and node.annotation is not None:
+                target, value = node.target, node.annotation
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                if isinstance(node.value, ast.Call):
+                    target, value = node.targets[0], node.value.func
+            if (
+                target is not None
+                and isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+                and target.attr not in ci.attr_types
+            ):
+                ci.attr_types[target.attr] = value
+
+    # -- symbol / type resolution ------------------------------------------
+
+    def _resolve_export(self, mod: str, name: str, _depth=0):
+        """What ``mod.name`` is: ("func"|"class"|"module", qual) or
+        None. Follows ``from x import y`` re-export chains (package
+        ``__init__`` facades)."""
+        if _depth > 8:
+            return None
+        if f"{mod}.{name}" in self.by_name:
+            return ("module", f"{mod}.{name}")
+        if mod not in self.by_name:
+            return None
+        if name in self._mod_functions.get(mod, {}):
+            return ("func", self._mod_functions[mod][name])
+        if name in self._mod_classes.get(mod, {}):
+            return ("class", self._mod_classes[mod][name].qual)
+        imp = self._mod_imports.get(mod, {}).get(name)
+        if imp is not None:
+            base, sym = imp
+            if sym is None:
+                return ("module", base) if base in self.by_name else None
+            return self._resolve_export(base, sym, _depth + 1)
+        if name in self._mod_instances.get(mod, {}):
+            cls = self._resolve_class_expr(mod, self._mod_instances[mod][name])
+            if cls is not None:
+                return ("instance", cls)
+        return None
+
+    def _resolve_class_expr(self, mod: str, expr) -> Optional[str]:
+        """Resolve a type-ish expression (``Name``, ``a.B``, a string
+        annotation, ``Optional[T]``, ``T | None``) to a class qual."""
+        if expr is None:
+            return None
+        if isinstance(expr, str):
+            try:
+                expr = ast.parse(expr, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return self._resolve_class_expr(mod, expr.value)
+        if isinstance(expr, ast.Subscript):  # Optional[T] / List[T] — inner
+            return self._resolve_class_expr(mod, expr.slice)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.BitOr):
+            return (
+                self._resolve_class_expr(mod, expr.left)
+                or self._resolve_class_expr(mod, expr.right)
+            )
+        if isinstance(expr, ast.Name):
+            if expr.id == "None":
+                return None
+            r = self._resolve_export(mod, expr.id)
+            return r[1] if r is not None and r[0] == "class" else None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            r = self._resolve_export(mod, expr.value.id)
+            if r is not None and r[0] == "module":
+                r2 = self._resolve_export(r[1], expr.attr)
+                return r2[1] if r2 is not None and r2[0] == "class" else None
+        return None
+
+    def _class_info(self, cls_qual: str) -> Optional[_ClassInfo]:
+        return self.classes.get(cls_qual)
+
+    def _lookup_method(self, cls_qual: str, name: str, _depth=0) -> Optional[str]:
+        ci = self.classes.get(cls_qual)
+        if ci is None or _depth > 8:
+            return None
+        if name in ci.methods:
+            return ci.methods[name]
+        for b in ci.bases:  # by-name base lookup within the same module
+            r = self._resolve_export(ci.module.module_name, b)
+            if r is not None and r[0] == "class":
+                m = self._lookup_method(r[1], name, _depth + 1)
+                if m is not None:
+                    return m
+        return None
+
+    def infer_type(self, info: FunctionInfo, expr: ast.expr) -> Optional[str]:
+        """Class qual of the value ``expr`` evaluates to inside
+        ``info``'s body, or None."""
+        mod = info.module.module_name
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and info.cls is not None:
+                return f"{mod}.{info.cls}"
+            ann = self._param_annotation(info, expr.id)
+            if ann is not None:
+                return self._resolve_class_expr(mod, ann)
+            local = self._local_ctor(info, expr.id)
+            if local is not None:
+                return self._resolve_class_expr(mod, local)
+            r = self._resolve_export(mod, expr.id)
+            if r is not None and r[0] == "instance":
+                return r[1]
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self.infer_type(info, expr.value)
+            if base is not None:
+                ci = self._class_info(base)
+                seen = set()
+                while ci is not None and ci.qual not in seen:
+                    seen.add(ci.qual)
+                    if expr.attr in ci.attr_types:
+                        return self._resolve_class_expr(
+                            ci.module.module_name, ci.attr_types[expr.attr]
+                        )
+                    nxt = None
+                    for b in ci.bases:
+                        r = self._resolve_export(ci.module.module_name, b)
+                        if r is not None and r[0] == "class":
+                            nxt = self._class_info(r[1])
+                            break
+                    ci = nxt
+            return None
+        if isinstance(expr, ast.Call):
+            cls = None
+            if isinstance(expr.func, (ast.Name, ast.Attribute)):
+                cls = self._resolve_value_class(info, expr.func)
+            return cls
+        return None
+
+    def _resolve_value_class(self, info, func_expr) -> Optional[str]:
+        """``Cls(...)`` constructor expression -> class qual."""
+        mod = info.module.module_name
+        if isinstance(func_expr, ast.Name):
+            r = self._resolve_export(mod, func_expr.id)
+            return r[1] if r is not None and r[0] == "class" else None
+        if isinstance(func_expr, ast.Attribute) and isinstance(func_expr.value, ast.Name):
+            r = self._resolve_export(mod, func_expr.value.id)
+            if r is not None and r[0] == "module":
+                r2 = self._resolve_export(r[1], func_expr.attr)
+                return r2[1] if r2 is not None and r2[0] == "class" else None
+        return None
+
+    def _param_annotation(self, info: FunctionInfo, name: str):
+        args = getattr(info.node, "args", None)
+        if args is None:
+            return None
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if a.arg == name:
+                return a.annotation
+        return None
+
+    def _local_ctor(self, info: FunctionInfo, name: str):
+        """The ``Cls(...)`` ctor expression a local name was assigned
+        from (first match wins; cached per function)."""
+        cache = self._fact_cache.setdefault("_local_ctors", {})
+        if info.qual not in cache:
+            ctors = {}
+            for node in ast.walk(info.node):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and node.targets[0].id not in ctors
+                ):
+                    ctors[node.targets[0].id] = node.value.func
+            cache[info.qual] = ctors
+        return cache[info.qual].get(name)
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(self, info: FunctionInfo, call: ast.Call) -> Optional[str]:
+        """Qualified name of the single parsed function this call can
+        reach, or None (unknown callee — propagation stops here)."""
+        try:
+            return self._resolve_call(info, call)
+        except Exception:  # graft-lint: ignore[silent-except] — resolution must never crash the lint; unresolved is the safe answer
+            return None
+
+    def _resolve_call(self, info: FunctionInfo, call: ast.Call) -> Optional[str]:
+        mod = info.module.module_name
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            r = self._resolve_export(mod, fn.id)
+            if r is None:
+                return None
+            if r[0] == "func":
+                return r[1]
+            if r[0] == "class":
+                return self._lookup_method(r[1], "__init__")
+            return None
+        if isinstance(fn, ast.Attribute):
+            # module-qualified chains: seg.WriteAheadLog.open, obs.inc
+            if isinstance(fn.value, ast.Name):
+                r = self._resolve_export(mod, fn.value.id)
+                if r is not None and r[0] == "module":
+                    r2 = self._resolve_export(r[1], fn.attr)
+                    if r2 is not None and r2[0] == "func":
+                        return r2[1]
+                    if r2 is not None and r2[0] == "class":
+                        return self._lookup_method(r2[1], "__init__")
+                    if r2 is not None and r2[0] == "instance":
+                        return None  # bare instance, no method — unreachable
+                    return None
+                if r is not None and r[0] == "instance":
+                    return self._lookup_method(r[1], fn.attr)
+            elif (
+                isinstance(fn.value, ast.Attribute)
+                and isinstance(fn.value.value, ast.Name)
+            ):
+                r = self._resolve_export(mod, fn.value.value.id)
+                if r is not None and r[0] == "module":
+                    r2 = self._resolve_export(r[1], fn.value.attr)
+                    if r2 is not None and r2[0] == "class":
+                        return self._lookup_method(r2[1], fn.attr)
+                    if r2 is not None and r2[0] == "instance":
+                        cls = self._class_info(r2[1])
+                        if cls is not None and fn.attr in cls.attr_types:
+                            pass  # attr of an instance: fall through to type inference
+            # receiver-typed resolution: self.m(), mut.wal.append(), ...
+            recv = self.infer_type(info, fn.value)
+            if recv is not None:
+                return self._lookup_method(recv, fn.attr)
+        return None
+
+    def calls_of(self, qual: str) -> List[Tuple[ast.Call, Optional[str]]]:
+        """Every call expression in ``qual``'s body (nested def/lambda
+        bodies excluded — deferred code) with its resolved target."""
+        if qual in self._calls:
+            return self._calls[qual]
+        info = self.functions.get(qual)
+        out: List[Tuple[ast.Call, Optional[str]]] = []
+        if info is not None:
+            for node in walk_executed(info.node.body):
+                if isinstance(node, ast.Call):
+                    out.append((node, self.resolve_call(info, node)))
+        self._calls[qual] = out
+        return out
+
+    # -- fact propagation --------------------------------------------------
+
+    def propagate(self, direct) -> Dict[str, Dict]:
+        """Cycle-safe fixpoint: ``direct(info)`` maps a function to its
+        locally-established facts ``{key: line}``; the result maps every
+        function to ``{key: (line, call_path)}`` where ``call_path`` is
+        the qual chain (possibly empty) from that function to the one
+        holding the fact directly. Recursion converges because facts
+        only accumulate."""
+        facts: Dict[str, Dict] = {}
+        for qual, info in self.functions.items():
+            try:
+                facts[qual] = {k: (ln, []) for k, ln in direct(info).items()}
+            except Exception:  # graft-lint: ignore[silent-except] — one weird function must not sink the whole pass
+                facts[qual] = {}
+        changed = True
+        while changed:
+            changed = False
+            for qual in self.functions:
+                mine = facts[qual]
+                for _, target in self.calls_of(qual):
+                    if target is None or target == qual:
+                        continue
+                    for key, (ln, path) in facts.get(target, {}).items():
+                        if key not in mine:
+                            mine[key] = (ln, [target] + path)
+                            changed = True
+        return facts
+
+    def blocking_facts(self) -> Dict[str, Dict]:
+        """function qual -> {(container_qual, primitive): (line, path)}.
+        The key keeps the primitive *and* the function that calls it
+        directly, so an allow-list can excuse one durability path (WAL
+        fsync) without excusing every fsync in the program."""
+        if "blocking" not in self._fact_cache:
+            def direct(info: FunctionInfo):
+                out = {}
+                for node in walk_executed(info.node.body):
+                    if isinstance(node, ast.Call):
+                        name = _last_name(node.func)
+                        if name in BLOCKING_PRIMITIVES:
+                            out[(info.qual, name)] = node.lineno
+                return out
+            self._fact_cache["blocking"] = self.propagate(direct)
+        return self._fact_cache["blocking"]
+
+    def collective_facts(self) -> Dict[str, Dict]:
+        """function qual -> {collective_name: (line, path)} — which SPMD
+        collectives the function may issue, directly or transitively."""
+        if "collective" not in self._fact_cache:
+            def direct(info: FunctionInfo):
+                out = {}
+                for node in walk_executed(info.node.body):
+                    if isinstance(node, ast.Call):
+                        name = _last_name(node.func)
+                        if name in COLLECTIVE_PRIMITIVES:
+                            out.setdefault(name, node.lineno)
+                return out
+            self._fact_cache["collective"] = self.propagate(direct)
+        return self._fact_cache["collective"]
+
+    def function_at(self, module: "LintModule", node: ast.AST) -> Optional[FunctionInfo]:
+        """The FunctionInfo whose def node is ``node`` in ``module``."""
+        return self._by_node.get(id(node))
+
+
+def walk_executed(stmts):
+    """Walk statements without descending into nested def/lambda bodies
+    — deferred code does not run at the point it is defined."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
 def all_checkers() -> List[Checker]:
     """The default checker set, import-cycle-free registry."""
-    from tools.graft_lint import comms_rules, jax_rules, pallas_rules, robust_rules
+    from tools.graft_lint import (
+        comms_rules,
+        concurrency_rules,
+        jax_rules,
+        pallas_rules,
+        registry_rules,
+        robust_rules,
+    )
 
     return [
         *jax_rules.CHECKERS,
         *pallas_rules.CHECKERS,
         *robust_rules.CHECKERS,
         *comms_rules.CHECKERS,
+        *concurrency_rules.CHECKERS,
+        *registry_rules.CHECKERS,
     ]
 
 
@@ -145,14 +667,27 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
                     yield os.path.join(root, f)
 
 
+def _check_module(
+    module: LintModule, checkers: Optional[Iterable[Checker]]
+) -> List[Violation]:
+    out: List[Violation] = []
+    for checker in checkers if checkers is not None else all_checkers():
+        for v in checker.check(module):
+            if not module.suppressed(v):
+                out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
 def lint_source(
     path: str,
     source: str,
     checkers: Optional[Iterable[Checker]] = None,
 ) -> List[Violation]:
-    """Lint one in-memory source buffer. Parse errors surface as a
-    single ``parse-error`` violation so broken files fail loudly rather
-    than silently passing the gate."""
+    """Lint one in-memory source buffer (as a one-module project, so
+    interprocedural rules see intra-module calls). Parse errors surface
+    as a single ``parse-error`` violation so broken files fail loudly
+    rather than silently passing the gate."""
     try:
         module = LintModule(path, source)
     except SyntaxError as e:
@@ -165,13 +700,23 @@ def lint_source(
         ]
     if module.skip_file:
         return []
-    out: List[Violation] = []
-    for checker in checkers if checkers is not None else all_checkers():
-        for v in checker.check(module):
-            if not module.suppressed(v):
-                out.append(v)
-    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
-    return out
+    LintProject([module])
+    return _check_module(module, checkers)
+
+
+def load_project(paths: Sequence[str]) -> "LintProject":
+    """Parse every .py under ``paths`` into one whole-program
+    :class:`LintProject` (unparseable files are dropped here — ``run_lint``
+    reports them separately)."""
+    modules: List[LintModule] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            modules.append(LintModule(path, source))
+        except (OSError, UnicodeDecodeError, SyntaxError):
+            continue
+    return LintProject(modules)
 
 
 def run_lint(
@@ -179,8 +724,9 @@ def run_lint(
     select: Optional[Sequence[str]] = None,
     ignore: Optional[Sequence[str]] = None,
 ) -> List[Violation]:
-    """Lint files/directories; returns unsuppressed violations sorted by
-    location. ``select``/``ignore`` filter by rule id."""
+    """Lint files/directories as one whole-program project; returns
+    unsuppressed violations sorted by location. ``select``/``ignore``
+    filter by rule id."""
     checkers = all_checkers()
     if select:
         wanted = set(select)
@@ -191,11 +737,27 @@ def run_lint(
     if ignore:
         checkers = [c for c in checkers if c.rule not in set(ignore)]
     out: List[Violation] = []
+    modules: List[LintModule] = []
     for path in iter_python_files(paths):
         try:
             with open(path, "r", encoding="utf-8") as f:
                 source = f.read()
         except (OSError, UnicodeDecodeError):
             continue
-        out.extend(lint_source(path, source, checkers))
+        try:
+            modules.append(LintModule(path, source))
+        except SyntaxError as e:
+            out.append(
+                Violation(
+                    rule="parse-error", path=path, line=e.lineno or 1,
+                    col=(e.offset or 0) + 1 if e.offset else 1,
+                    message=f"could not parse: {e.msg}",
+                )
+            )
+    LintProject(modules)  # sets module.project on every module
+    for module in modules:
+        if module.skip_file:
+            continue
+        out.extend(_check_module(module, checkers))
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return out

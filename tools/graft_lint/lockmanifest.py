@@ -1,0 +1,244 @@
+"""The declared lock-order manifest (``lock_order.toml``).
+
+The concurrency contract of the serving stack used to live in one
+comment (``segments.py``: "_compact_mutex strictly before _lock").
+``lock_order.toml`` is that contract made machine-checkable: every
+tracked lock gets a canonical name, an attribute spelling, and the
+class/path context that disambiguates the five different ``_lock``
+attributes in the tree; every *permitted* acquisition edge (lock held →
+lock acquired) is declared explicitly with a rationale. Two consumers
+read it:
+
+* the static ``lock-order`` rule (:mod:`tools.graft_lint.concurrency_rules`)
+  derives the actual edge set from the call graph and reports any edge
+  the manifest does not permit (an inversion of a declared edge is a
+  potential deadlock; a novel edge is manifest drift);
+* the runtime lock-witness (:mod:`raft_tpu.utils.lockcheck`) records the
+  edges real threads take under chaos and asserts each against the same
+  declarations, so the static graph can never silently rot.
+
+``may_block`` marks a lock whose holders are *expected* to block (the
+compaction mutex serializes whole rebuilds; nobody latency-sensitive
+contends on it), exempting it from ``blocking-under-lock``.
+``[[allow_blocking]]`` entries excuse one named callee (suffix match on
+the qualified name) under one named lock — the durable-then-visible WAL
+fsync is the canonical example: blocking, under ``_lock``, and the
+whole point.
+
+Parsing prefers :mod:`tomllib`/:mod:`tomli`; a dependency-free subset
+parser (tables-of-arrays with string/bool/string-array values — exactly
+what the manifest uses) keeps the linter runnable without either.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_MANIFEST_PATH = os.path.join(os.path.dirname(__file__), "lock_order.toml")
+
+
+def parse_toml_subset(text: str) -> dict:
+    """Parse the TOML subset the manifest uses: top-level ``key = value``
+    pairs and ``[[array.of.tables]]`` sections, with string, boolean,
+    and string-array values."""
+    root: dict = {}
+    current = root
+
+    def _value(raw: str):
+        raw = raw.strip()
+        if raw.startswith("["):
+            inner = raw.strip()[1:-1]
+            items = []
+            for part in inner.split(","):
+                part = part.strip()
+                if part:
+                    items.append(_value(part))
+            return items
+        if raw.startswith('"') and raw.endswith('"'):
+            return raw[1:-1]
+        if raw in ("true", "false"):
+            return raw == "true"
+        try:
+            return int(raw)
+        except ValueError:
+            return raw
+
+    for line in text.splitlines():
+        # strip comments outside strings (manifest strings carry no '#')
+        if "#" in line:
+            line = line.split("#", 1)[0]
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            name = line[2:-2].strip()
+            current = {}
+            root.setdefault(name, []).append(current)
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            name = line[1:-1].strip()
+            current = root.setdefault(name, {})
+            continue
+        if "=" in line:
+            key, raw = line.split("=", 1)
+            current[key.strip()] = _value(raw)
+    return root
+
+
+def _load_toml(path: str) -> dict:
+    with open(path, "rb") as f:
+        data = f.read()
+    text = data.decode("utf-8")
+    try:
+        import tomllib  # Python >= 3.11
+    except ImportError:
+        try:
+            import tomli as tomllib  # type: ignore[no-redef]
+        except ImportError:
+            return parse_toml_subset(text)
+    return tomllib.loads(text)
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDecl:
+    """One tracked lock: canonical name + how to recognize it."""
+
+    name: str                     # canonical id, e.g. "mutable.lock"
+    attr: str                     # attribute spelling, e.g. "_lock"
+    classes: Tuple[str, ...]      # owning class names ("" for module-level)
+    where: Tuple[str, ...]        # path prefixes it lives under
+    may_block: bool = False       # holders are expected to block
+
+
+class LockManifest:
+    """Parsed ``lock_order.toml``: lock declarations, the permitted
+    acquisition-edge set, and the blocking allow-list."""
+
+    def __init__(self, data: dict, path: str = DEFAULT_MANIFEST_PATH):
+        self.path = path
+        self.locks: Dict[str, LockDecl] = {}
+        self.scan: Tuple[str, ...] = tuple(data.get("scan", []))
+        for entry in data.get("lock", []):
+            decl = LockDecl(
+                name=entry["name"],
+                attr=entry["attr"],
+                classes=tuple(entry.get("classes", [])),
+                where=tuple(entry.get("where", [])),
+                may_block=bool(entry.get("may_block", False)),
+            )
+            self.locks[decl.name] = decl
+        self.edges: Dict[Tuple[str, str], str] = {}
+        for entry in data.get("edge", []):
+            self.edges[(entry["from"], entry["to"])] = entry.get("why", "")
+        self.allow_blocking: List[Tuple[str, str, str]] = [
+            (e["lock"], e["callee"], e.get("why", ""))
+            for e in data.get("allow_blocking", [])
+        ]
+        self._by_attr: Dict[str, List[LockDecl]] = {}
+        for decl in self.locks.values():
+            self._by_attr.setdefault(decl.attr, []).append(decl)
+
+    @classmethod
+    def load(cls, path: str = DEFAULT_MANIFEST_PATH) -> "LockManifest":
+        return cls(_load_toml(path), path=path)
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(
+        self,
+        attr: str,
+        class_name: Optional[str],
+        path: str,
+    ) -> Optional[LockDecl]:
+        """The declared lock an acquisition site refers to, given the
+        attribute spelling, the (inferred) owning class, and the file.
+        Precedence: class match > path-prefix match > globally unique
+        attribute. None means undeclared."""
+        cands = self._by_attr.get(attr, [])
+        if not cands:
+            return None
+        if class_name:
+            by_cls = [d for d in cands if class_name in d.classes]
+            if len(by_cls) == 1:
+                return by_cls[0]
+        norm = path.replace(os.sep, "/")
+        by_path = [
+            d for d in cands
+            if any(w and w in norm for w in d.where)
+        ]
+        if len(by_path) == 1:
+            return by_path[0]
+        if len(by_path) > 1:  # longest prefix wins
+            by_path.sort(key=lambda d: -max(len(w) for w in d.where if w in norm))
+            return by_path[0]
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def in_scanned_scope(self, path: str) -> bool:
+        norm = path.replace(os.sep, "/")
+        return any(prefix in norm for prefix in self.scan)
+
+    def permits(self, held: str, acquired: str) -> bool:
+        """Whether acquiring ``acquired`` while holding ``held`` is a
+        declared edge (re-acquiring the same lock is reentrancy, always
+        permitted — the RLocks handle it)."""
+        return held == acquired or (held, acquired) in self.edges
+
+    def allows_blocking(
+        self, lock: str, chain: Sequence[str], primitive: str
+    ) -> bool:
+        """Whether a blocking call under ``lock`` is excused: some
+        function along the call chain (or the primitive itself) matches
+        an ``[[allow_blocking]]`` callee for this lock. Matching is by
+        dotted-suffix, so ``callee = "WriteAheadLog.append"`` covers
+        every path through the WAL's durable append."""
+        for al_lock, callee, _why in self.allow_blocking:
+            if al_lock != lock:
+                continue
+            for qual in list(chain) + [primitive]:
+                if qual == callee or qual.endswith("." + callee):
+                    return True
+        return False
+
+    def declared_cycles(self) -> List[List[str]]:
+        """Cycles in the *declared* edge set — a manifest that permits a
+        cycle is itself a deadlock license and gets reported."""
+        graph: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, []).append(b)
+        cycles: List[List[str]] = []
+        state: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def visit(node: str) -> None:
+            state[node] = 1
+            stack.append(node)
+            for nxt in graph.get(node, []):
+                if state.get(nxt, 0) == 1:
+                    cycles.append(stack[stack.index(nxt):] + [nxt])
+                elif state.get(nxt, 0) == 0:
+                    visit(nxt)
+            stack.pop()
+            state[node] = 2
+
+        for node in list(graph):
+            if state.get(node, 0) == 0:
+                visit(node)
+        return cycles
+
+
+_cached: Dict[str, LockManifest] = {}
+
+
+def load_manifest(path: str = DEFAULT_MANIFEST_PATH) -> Optional[LockManifest]:
+    """Load-and-cache; None when the manifest file is absent (the rules
+    then stay silent rather than guessing)."""
+    key = os.path.abspath(path)
+    if key not in _cached:
+        try:
+            _cached[key] = LockManifest.load(path)
+        except (OSError, KeyError, TypeError, ValueError):
+            return None
+    return _cached[key]

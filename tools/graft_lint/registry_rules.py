@@ -1,0 +1,183 @@
+"""Registry-drift checkers: code-side registries vs. their docs.
+
+Two registries in the tree exist to be *looked up by humans mid-
+incident*: the fault-injection seams (``raft_tpu/robust/faults.py:
+FAULT_POINTS``) and the metric names the obs layer emits. Both rot the
+same way — a seam or metric is added in code, the doc table is not
+updated, and six months later the on-call greps for a name that is not
+where the runbook says it is. These rules make the drift a lint
+failure:
+
+* ``fault-point-drift`` — every seam string in a module-level
+  ``FAULT_POINTS`` registry must appear in ``docs/robustness.md`` (the
+  seam catalog) and in at least one test under ``tests/`` (excluding
+  ``tests/fixtures/`` — a fixture exercising the linter is not a test
+  of the seam). An undocumented seam cannot be used in a drill; an
+  untested seam is dead chaos code.
+
+* ``metric-drift`` — every metric name passed as a string literal to
+  ``obs.inc`` / ``obs.observe`` / ``obs.set_gauge`` must appear in
+  ``docs/observability.md``. Dynamic names (variables, f-strings) are
+  out of scope — the doc table documents the static namespace.
+
+Both rules locate the repo root by walking up from the linted file to
+a directory containing ``docs/``; files outside any such layout are
+skipped (the rules are about *this* repo's contract, not a general
+property of Python).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.graft_lint.core import Checker, LintModule, Violation
+
+#: obs-facade emitters whose first positional argument is a metric name
+_EMITTERS = frozenset({"inc", "observe", "set_gauge"})
+
+
+def _repo_root(path: str) -> Optional[str]:
+    """Nearest ancestor of ``path`` containing a ``docs`` directory."""
+    d = os.path.dirname(os.path.abspath(path))
+    while True:
+        if os.path.isdir(os.path.join(d, "docs")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+class _DocCorpus:
+    """Per-root cached text of doc files and the test corpus."""
+
+    def __init__(self):
+        self._docs: Dict[Tuple[str, str], Optional[str]] = {}
+        self._tests: Dict[str, str] = {}
+
+    def doc_text(self, root: str, name: str) -> Optional[str]:
+        key = (root, name)
+        if key not in self._docs:
+            p = os.path.join(root, "docs", name)
+            try:
+                with open(p, "r", encoding="utf-8") as f:
+                    self._docs[key] = f.read()
+            except OSError:
+                self._docs[key] = None
+        return self._docs[key]
+
+    def tests_text(self, root: str) -> str:
+        if root not in self._tests:
+            chunks: List[str] = []
+            tests_dir = os.path.join(root, "tests")
+            for dirpath, dirnames, filenames in os.walk(tests_dir):
+                # a linter fixture mentioning a seam is not a test of it
+                dirnames[:] = [d for d in dirnames if d != "fixtures"]
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        try:
+                            with open(
+                                os.path.join(dirpath, fname), "r",
+                                encoding="utf-8",
+                            ) as f:
+                                chunks.append(f.read())
+                        except OSError:  # graft-lint: ignore[silent-except] — an unreadable test file just shrinks the corpus
+                            pass
+            self._tests[root] = "\n".join(chunks)
+        return self._tests[root]
+
+
+_corpus = _DocCorpus()
+
+
+class FaultPointDriftChecker(Checker):
+    rule = "fault-point-drift"
+    doc = (
+        "FAULT_POINTS seam missing from docs/robustness.md or not "
+        "exercised by any test — an undocumented seam cannot be used "
+        "in a drill; an untested seam is dead chaos code"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        root = None
+        for node in module.tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            if not any(
+                isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
+                for t in targets
+            ):
+                continue
+            value = node.value
+            if not isinstance(value, (ast.Tuple, ast.List)) or value is None:
+                continue
+            if root is None:
+                root = _repo_root(module.path)
+            if root is None:
+                return
+            doc = _corpus.doc_text(root, "robustness.md")
+            tests = _corpus.tests_text(root)
+            for elt in value.elts:
+                if not (
+                    isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+                ):
+                    continue
+                seam = elt.value
+                missing = []
+                if doc is None or seam not in doc:
+                    missing.append("docs/robustness.md")
+                if seam not in tests:
+                    missing.append("any test under tests/")
+                if missing:
+                    yield self.violation(
+                        module, elt,
+                        f"fault point '{seam}' is missing from "
+                        f"{' and from '.join(missing)} — add it to the "
+                        "seam catalog and exercise it (an undrillable "
+                        "seam is dead chaos code)",
+                    )
+
+
+class MetricDriftChecker(Checker):
+    rule = "metric-drift"
+    doc = (
+        "metric name emitted via obs.inc/observe/set_gauge but absent "
+        "from docs/observability.md — the on-call greps the doc table "
+        "first; keep it truthful"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        root = None
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name not in _EMITTERS or not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                continue  # dynamic names are out of the static namespace
+            metric = arg.value
+            if root is None:
+                root = _repo_root(module.path) or ""
+            if not root:
+                return
+            doc = _corpus.doc_text(root, "observability.md")
+            if doc is None or metric not in doc:
+                yield self.violation(
+                    module, node,
+                    f"metric '{metric}' is not documented in "
+                    "docs/observability.md — add a row (name, type, "
+                    "labels, meaning) so the emitted namespace and the "
+                    "doc table cannot drift",
+                )
+
+
+CHECKERS = [FaultPointDriftChecker(), MetricDriftChecker()]

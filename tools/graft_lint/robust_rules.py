@@ -24,19 +24,24 @@ defeats all of it is the silently swallowed exception:
   flagged; transient debug/scratch output gets a rationale'd
   ``# graft-lint: ignore[non-atomic-write]``.
 
-* ``blocking-under-lock`` — an index build, artifact write, or device
-  sync dispatched while a ``threading.Lock``/mutex context is held.
-  Every writer and searcher contending on that lock waits out the
-  whole operation — the p99 becomes the rebuild time (the exact bug
-  background compaction removes: pin under the lock, rebuild outside
-  it, re-enter briefly for the flip). The check is lexical: it flags
-  known-blocking call names (``build``/``fit``/``save_path``/
-  ``swap``/``block_until_ready``/…) in the body of a ``with`` whose
-  context expression names a lock, skipping nested ``def``/``lambda``
-  bodies (deferred, not executed under the lock). Deliberately
-  blocking sections — a documented foreground mode, a flip that ends
-  in one rename — carry a rationale'd
-  ``# graft-lint: ignore[blocking-under-lock]``.
+* ``blocking-under-lock`` — an index build, artifact write, sleep, or
+  device sync dispatched while a ``threading.Lock``/mutex context is
+  held. Every writer and searcher contending on that lock waits out
+  the whole operation — the p99 becomes the rebuild time (the exact
+  bug background compaction removes: pin under the lock, rebuild
+  outside it, re-enter briefly for the flip). The check is
+  interprocedural: blocking primitives (``build``/``fsync``/
+  ``rmtree``/``sleep``/… — :data:`tools.graft_lint.core.
+  BLOCKING_PRIMITIVES`) are propagated over the project call graph, so
+  a call that *reaches* an fsync three frames down is flagged at the
+  call site under the lock. Locks resolved against
+  ``lock_order.toml`` get contract-aware treatment: a ``may_block``
+  lock (the compaction mutex serializes whole rebuilds by design)
+  exempts its body, and ``[[allow_blocking]]`` entries excuse one
+  callee path under one lock (the durable-then-visible WAL fsync).
+  Lock-like ``with`` s the manifest does not know fall back to the
+  lexical direct-call check; residual deliberate sites carry a
+  rationale'd ``# graft-lint: ignore[blocking-under-lock]``.
 
 * ``unbounded-queue`` — a work-queue construction with no bound:
   ``queue.Queue()`` / ``LifoQueue()`` / ``PriorityQueue()`` without a
@@ -53,7 +58,15 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from tools.graft_lint.core import Checker, LintModule, Violation
+from tools.graft_lint import lockmanifest
+from tools.graft_lint.concurrency_rules import resolve_lock
+from tools.graft_lint.core import (
+    BLOCKING_PRIMITIVES,
+    Checker,
+    LintModule,
+    Violation,
+    walk_executed,
+)
 
 
 def _is_noop(stmt: ast.stmt) -> bool:
@@ -245,22 +258,9 @@ class NonAtomicWriteChecker(Checker):
 #: lock acquisition (``self._lock``, ``mut._compact_mutex``, …)
 _LOCK_HINTS = ("lock", "mutex")
 
-#: call names that block for corpus-proportional (build/save) or
-#: device-roundtrip time — too long for a writer-contended critical
-#: section
-_BLOCKING_NAMES = frozenset(
-    {
-        # index builds / model fits
-        "build", "rebuild", "fit", "_build_main",
-        # artifact writes and durability loops
-        "atomic_write", "save_path", "save_stream", "_save_rows",
-        "_save_main", "_write_generation", "fsync",
-        # the manifest flip and its wrapper
-        "swap", "_publish",
-        # device synchronization / transfer
-        "block_until_ready", "device_put",
-    }
-)
+#: the direct blocking seeds now live in core (the call graph
+#: propagates them); this alias keeps the lexical fallback in sync
+_BLOCKING_NAMES = BLOCKING_PRIMITIVES
 
 
 def _last_component(expr):
@@ -295,35 +295,117 @@ def _walk_executed(stmts):
 class BlockingUnderLockChecker(Checker):
     rule = "blocking-under-lock"
     doc = (
-        "index build / artifact write / device sync inside a held "
-        "threading lock — writers and searchers queue behind the whole "
-        "operation; pin under the lock, do the work outside, re-enter "
-        "for the flip"
+        "index build / artifact write / device sync reachable (through "
+        "calls) while a held threading lock is held — writers and "
+        "searchers queue behind the whole operation; pin under the "
+        "lock, do the work outside, re-enter for the flip"
     )
 
     def check(self, module: LintModule) -> Iterator[Violation]:
+        project = getattr(module, "project", None)
+        manifest = lockmanifest.load_manifest()
+        blocking = project.blocking_facts() if project is not None else {}
+        # map executed nodes to their enclosing indexed function so
+        # with-contexts and calls can use receiver-type resolution
+        owner = {}
+        if project is not None:
+            for info in project.functions.values():
+                if info.module is not module:
+                    continue
+                for n in walk_executed(info.node.body):
+                    owner[id(n)] = info
         flagged = set()
         for node in ast.walk(module.tree):
             if not isinstance(node, (ast.With, ast.AsyncWith)):
                 continue
-            if not any(_is_lock_expr(item.context_expr) for item in node.items):
+            lockish = [
+                item for item in node.items
+                if _is_lock_expr(item.context_expr)
+            ]
+            if not lockish:
                 continue
+            info = owner.get(id(node))
+            decls, unresolved = [], False
+            if manifest is not None:
+                for item in lockish:
+                    d = resolve_lock(project, manifest, module, info, item.context_expr)
+                    if d is None:
+                        unresolved = True
+                    else:
+                        decls.append(d)
+            else:
+                unresolved = True
+            if decls and not unresolved and all(d.may_block for d in decls):
+                # holders of this lock are expected to block (e.g. the
+                # compaction mutex serializes whole rebuilds); inner
+                # locks are judged at their own `with`
+                continue
+            judge = [d for d in decls if not d.may_block]
+            lexical_only = unresolved or not judge or project is None
             for child in _walk_executed(node.body):
                 if not isinstance(child, ast.Call) or id(child) in flagged:
                     continue
                 name = _last_component(child.func)
-                if name in _BLOCKING_NAMES:
-                    flagged.add(id(child))
-                    yield self.violation(
-                        module, child,
-                        f"{name}() runs while a lock is held — writers and "
-                        "searchers queue behind it for the whole call; "
-                        "pin state under the lock, run the blocking work "
-                        "outside it, and re-enter only for the pointer "
-                        "flip (see raft_tpu.mutable.maintenance), or "
-                        "suppress with a rationale where blocking is the "
-                        "documented contract",
-                    )
+                direct_hit = name in _BLOCKING_NAMES
+                target = None
+                if project is not None and info is not None:
+                    target = project.resolve_call(info, child)
+                if lexical_only:
+                    if direct_hit:
+                        flagged.add(id(child))
+                        yield self.violation(
+                            module, child,
+                            f"{name}() runs while a lock is held — writers "
+                            "and searchers queue behind it for the whole "
+                            "call; pin state under the lock, run the "
+                            "blocking work outside it, and re-enter only "
+                            "for the pointer flip (see "
+                            "raft_tpu.mutable.maintenance), or suppress "
+                            "with a rationale where blocking is the "
+                            "documented contract",
+                        )
+                    continue
+                for d in judge:
+                    if direct_hit:
+                        chain = [target] if target else []
+                        if manifest.allows_blocking(d.name, chain, name):
+                            continue
+                        flagged.add(id(child))
+                        yield self.violation(
+                            module, child,
+                            f"{name}() blocks while {d.name} is held — "
+                            "everyone contending on it waits out the call; "
+                            "move it outside the critical section, or add "
+                            "an [[allow_blocking]] entry to lock_order."
+                            "toml / an inline rationale where blocking is "
+                            "the contract",
+                        )
+                        break
+                    if target is None:
+                        continue
+                    hit = None
+                    for (container, prim), (_ln, path) in blocking.get(
+                        target, {}
+                    ).items():
+                        chain = [target] + path
+                        if not chain or chain[-1] != container:
+                            chain.append(container)
+                        if not manifest.allows_blocking(d.name, chain, prim):
+                            hit = (prim, chain)
+                            break
+                    if hit is not None:
+                        prim, chain = hit
+                        flagged.add(id(child))
+                        yield self.violation(
+                            module, child,
+                            f"{name}() reaches {prim}() (via "
+                            f"{' -> '.join(chain)}) while {d.name} is held "
+                            "— the critical section blocks for the whole "
+                            "downstream operation; restructure, or excuse "
+                            "this path with an [[allow_blocking]] entry in "
+                            "lock_order.toml",
+                        )
+                        break
 
 
 CHECKERS = [
