@@ -1065,6 +1065,10 @@ def _bench_main():
             srows = 8
             n_req = 64 if os.environ.get("RAFT_TPU_BENCH_SMOKE") else 256
             for index_id, salgo in serve_targets:
+                # 99% of requests under 250ms over the bench's lifetime;
+                # short alert windows so the burn state moves within a run
+                engine.set_slo(index_id, latency_ms=250.0, target=0.99,
+                               fast_window_s=5.0, slow_window_s=20.0)
                 engine.warmup(index_id, K)
                 rep_c, got_c = run_closed_loop(
                     engine, index_id, qpool, K,
@@ -1097,6 +1101,42 @@ def _bench_main():
                         f"  recall={rec_val:.4f} rej={srow['rejected']}",
                         flush=True,
                     )
+                    slo_state = (engine.health()["indexes"]
+                                 .get(index_id, {}).get("slo"))
+                    if slo_state:
+                        print(
+                            f"#   slo[{index_id}]: budget_remaining="
+                            f"{slo_state['budget_remaining']:.3f}"
+                            f" burn_fast={slo_state['burn_fast']:.2f}"
+                            f" burn_slow={slo_state['burn_slow']:.2f}"
+                            f" alerting={slo_state['alerting']}",
+                            flush=True,
+                        )
+            # chaos sub-run: inject latency at the dispatch seam and prove
+            # the p99 exemplar resolves to a complete request trace —
+            # the "which request made p99, and where did it go" claim,
+            # exercised on every bench run rather than only in tests
+            if serve_targets and obs.is_enabled():
+                from raft_tpu.robust import faults as _faults
+
+                index_id, salgo = serve_targets[0]
+                with _faults.injected("serve.dispatch", latency_s=0.05,
+                                      trigger="first_n", first_n=2):
+                    rep_x, _ = run_closed_loop(
+                        engine, index_id, qpool, K,
+                        concurrency=4, n_requests=16, request_rows=srows,
+                    )
+                worst = rep_x.worst_trace()
+                tspans = list(obs.iter_trace_spans(obs.registry(), worst)) \
+                    if worst else []
+                tnames = {s["name"] for s in tspans}
+                assert worst and {"serve.queue", "serve.dispatch"} <= tnames, (
+                    f"chaos exemplar trace incomplete: trace={worst!r} "
+                    f"spans={sorted(tnames)}"
+                )
+                print(f"# serve chaos: worst trace {worst} resolved to "
+                      f"{len(tspans)} spans ({', '.join(sorted(tnames))})",
+                      flush=True)
             cs = engine.cache.stats()
             print(f"# serve cache: {cs.distinct_programs} compiled programs "
                   f"({cs.hits} hits / {cs.misses} misses)", flush=True)

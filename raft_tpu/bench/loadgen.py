@@ -65,6 +65,15 @@ class LoadReport:
     latency_ms_max: float
     #: raw per-request latencies (ms), completion order
     latencies_ms: List[float] = dataclasses.field(repr=False, default_factory=list)
+    #: trace IDs aligned with ``latencies_ms`` ("" with obs off) — what
+    #: lets the p99 sample resolve to a concrete request trace
+    trace_ids: List[str] = dataclasses.field(repr=False, default_factory=list)
+
+    def worst_trace(self) -> str:
+        """Trace ID of the slowest completed request ("" when untraced)."""
+        if not self.latencies_ms:
+            return ""
+        return self.trace_ids[self.latencies_ms.index(self.latency_ms_max)]
 
     def row(self) -> Dict[str, float]:
         """The bench-row projection (what lands in results.json)."""
@@ -78,7 +87,9 @@ class LoadReport:
         }
 
 
-def _report(mode, n_requests, completed, rejected, duration_s, rows_done, lats_ms):
+def _report(mode, n_requests, completed, rejected, duration_s, rows_done,
+            lats_ms, trace_ids=None):
+    trace_ids = trace_ids if trace_ids is not None else [""] * len(lats_ms)
     report = LoadReport(
         mode=mode,
         n_requests=n_requests,
@@ -92,13 +103,16 @@ def _report(mode, n_requests, completed, rejected, duration_s, rows_done, lats_m
         latency_ms_p99=percentile(lats_ms, 99),
         latency_ms_max=max(lats_ms) if lats_ms else 0.0,
         latencies_ms=lats_ms,
+        trace_ids=trace_ids,
     )
     if obs.is_enabled():
         obs.set_gauge("loadgen.throughput_qps", report.throughput_qps, mode=mode)
         obs.set_gauge("loadgen.p50_ms", report.latency_ms_p50, mode=mode)
         obs.set_gauge("loadgen.p99_ms", report.latency_ms_p99, mode=mode)
-        for v in lats_ms:
-            obs.observe("loadgen.latency_ms", v, mode=mode)
+        for v, t in zip(lats_ms, trace_ids):
+            # exemplar-enabled: the tail bucket keeps the worst request's
+            # trace, so "what made p99" is answerable after the run
+            obs.observe("loadgen.latency_ms", v, trace_id=t or None, mode=mode)
     return report
 
 
@@ -131,6 +145,7 @@ def run_open_loop(
     pending: List[Tuple[float, object, np.ndarray]] = []  # (t_arrival, future, row_ids)
     rejected: Dict[str, int] = {}
     lats_ms: List[float] = []
+    trace_ids: List[str] = []
     results: List[Tuple[np.ndarray, np.ndarray]] = []
     rows_done = 0
     completed = 0
@@ -166,13 +181,15 @@ def run_open_loop(
                 continue
             res = fut.result()
             lats_ms.append((done_at - t_arr) * 1e3)
+            trace_ids.append(res.trace_id)
             rows_done += res.indices.shape[0]
             completed += 1
             if collect:
                 results.append((ids, res.indices))
         pending = still
     duration = time.perf_counter() - t0
-    return _report("open", n_requests, completed, rejected, duration, rows_done, lats_ms), results
+    return _report("open", n_requests, completed, rejected, duration, rows_done,
+                   lats_ms, trace_ids), results
 
 
 def run_closed_loop(
@@ -197,6 +214,7 @@ def run_closed_loop(
     pending: List[Tuple[float, object, np.ndarray]] = []
     rejected: Dict[str, int] = {}
     lats_ms: List[float] = []
+    trace_ids: List[str] = []
     results: List[Tuple[np.ndarray, np.ndarray]] = []
     rows_done = 0
     completed = 0
@@ -228,10 +246,12 @@ def run_closed_loop(
                 continue
             res = fut.result()
             lats_ms.append((t_done - t_sub) * 1e3)
+            trace_ids.append(res.trace_id)
             rows_done += res.indices.shape[0]
             completed += 1
             if collect:
                 results.append((ids, res.indices))
         pending = still
     duration = time.perf_counter() - t0
-    return _report("closed", n_requests, completed, rejected, duration, rows_done, lats_ms), results
+    return _report("closed", n_requests, completed, rejected, duration, rows_done,
+                   lats_ms, trace_ids), results

@@ -36,6 +36,14 @@ from raft_tpu.obs.metrics import (
     registry,
     set_gauge,
 )
+from raft_tpu.obs.request import (
+    NULL_SCOPE,
+    current_trace,
+    iter_trace_spans,
+    new_trace_id,
+    trace_scope,
+)
+from raft_tpu.obs.slo import SLO, SloStatus, SloTracker
 from raft_tpu.obs.spans import Span, span, traced
 
 __all__ = [
@@ -43,18 +51,26 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "NULL_SCOPE",
     "Registry",
+    "SLO",
+    "SloStatus",
+    "SloTracker",
     "Span",
     "chrome_trace",
+    "current_trace",
     "disable",
     "enable",
     "inc",
     "is_enabled",
+    "iter_trace_spans",
     "load_trace",
+    "new_trace_id",
     "observe",
     "registry",
     "set_gauge",
     "span",
+    "trace_scope",
     "traced",
     "validate_trace",
     "write_metrics_jsonl",

@@ -9,13 +9,23 @@ each counter is sampled once at trace end as a counter event
 ``ui.perfetto.dev`` (or ``chrome://tracing``); :func:`validate_trace`
 is the schema check the round-trip tests and ``tools/obs_report.py``
 share.
+
+Spans tagged with request trace IDs (:mod:`raft_tpu.obs.request`)
+additionally produce **flow events** (``"ph": "s"/"t"/"f"``): one arrow
+chain per trace ID, binding to the tagged slices in timestamp order.
+That is what makes one request render as a connected track across
+threads in Perfetto — the synthetic per-request ``serve.queue`` slice,
+the worker thread's ``serve.dispatch``, and the tiered ``host.fetch`` /
+refine slices are visually chained even though they live on different
+``tid`` s.
 """
 from __future__ import annotations
 
 import io
 import json
 import os
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional
 
 from raft_tpu.core import serialize
 from raft_tpu.obs import metrics as _metrics
@@ -27,20 +37,49 @@ def chrome_trace(registry: Optional[_metrics.Registry] = None) -> Dict[str, Any]
     pid = os.getpid()
     events = []
     end_ts = 0.0
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
     for s in reg.spans():
         end_ts = max(end_ts, s["ts_us"] + s["dur_us"])
-        events.append(
-            {
-                "ph": "X",
-                "name": s["name"],
-                "cat": "raft_tpu",
-                "ts": round(s["ts_us"], 3),
-                "dur": round(s["dur_us"], 3),
+        args = {**s["args"], "depth": s["depth"]}
+        trace = s.get("trace") or ()
+        if trace:
+            args["trace"] = list(trace)
+        ev = {
+            "ph": "X",
+            "name": s["name"],
+            "cat": "raft_tpu",
+            "ts": round(s["ts_us"], 3),
+            "dur": round(s["dur_us"], 3),
+            "pid": pid,
+            "tid": s["tid"],
+            "args": args,
+        }
+        events.append(ev)
+        for t in trace:
+            by_trace.setdefault(t, []).append(ev)
+    # one flow chain per trace ID: start on the earliest tagged slice,
+    # step through the rest, finish (enclosing bind) on the last — this
+    # is what draws the request's arrows across thread tracks
+    for trace_id, evs in sorted(by_trace.items()):
+        if len(evs) < 2:
+            continue  # an arrow needs two endpoints
+        evs.sort(key=lambda e: (e["ts"], e["args"]["depth"]))
+        flow_id = zlib.crc32(trace_id.encode("utf-8"))
+        for j, ev in enumerate(evs):
+            ph = "s" if j == 0 else ("f" if j == len(evs) - 1 else "t")
+            flow = {
+                "ph": ph,
+                "name": "request",
+                "cat": "trace",
+                "id": flow_id,
+                "ts": ev["ts"],
                 "pid": pid,
-                "tid": s["tid"],
-                "args": {**s["args"], "depth": s["depth"]},
+                "tid": ev["tid"],
+                "args": {"trace": trace_id},
             }
-        )
+            if ph == "f":
+                flow["bp"] = "e"
+            events.append(flow)
     snap = reg.as_dict()
     for key, value in snap["counters"].items():
         events.append(
@@ -92,6 +131,17 @@ def validate_trace(doc: Any) -> None:
                 raise ValueError(f"traceEvents[{i}]: counter event needs a 'name'")
             if not isinstance(ev.get("args"), dict):
                 raise ValueError(f"traceEvents[{i}]: counter event needs 'args'")
+        elif ph in ("s", "t", "f"):
+            if not isinstance(ev.get("name"), str):
+                raise ValueError(f"traceEvents[{i}]: flow event needs a 'name'")
+            if not isinstance(ev.get("id"), (int, str)) or isinstance(ev.get("id"), bool):
+                raise ValueError(f"traceEvents[{i}]: flow event needs an 'id'")
+            v = ev.get("ts")
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise ValueError(f"traceEvents[{i}]: 'ts' must be a number")
+            for field in ("pid", "tid"):
+                if not isinstance(ev.get(field), int):
+                    raise ValueError(f"traceEvents[{i}]: '{field}' must be an int")
 
 
 def write_trace(path: str, registry: Optional[_metrics.Registry] = None) -> str:

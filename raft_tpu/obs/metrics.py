@@ -106,9 +106,20 @@ class Gauge:
 class Histogram:
     """Fixed-bucket histogram: ``buckets`` are sorted upper bounds; one
     implicit +Inf bucket catches the tail. Tracks sum and count like the
-    Prometheus histogram type."""
+    Prometheus histogram type.
 
-    __slots__ = ("name", "labels", "buckets", "counts", "sum", "count", "_lock")
+    ``observe(value, trace_id=...)`` additionally keeps one **exemplar**
+    per bucket — the worst (largest) value seen with a trace attached —
+    so a tail bucket resolves to a concrete request trace instead of an
+    anonymous count (the Prometheus/OpenMetrics exemplar idea, but
+    max-retaining rather than last-write, because the question the
+    serving path asks is "which request made p99").
+    """
+
+    __slots__ = (
+        "name", "labels", "buckets", "counts", "sum", "count",
+        "exemplars", "_lock",
+    )
     kind = "histogram"
 
     def __init__(
@@ -124,14 +135,31 @@ class Histogram:
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.count = 0
+        #: bucket index -> (value, trace_id); worst value per bucket wins
+        self.exemplars: Dict[int, Tuple[float, str]] = {}
         self._lock = lock
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         value = float(value)
         with self._lock:
-            self.counts[bisect.bisect_left(self.buckets, value)] += 1
+            bi = bisect.bisect_left(self.buckets, value)
+            self.counts[bi] += 1
             self.sum += value
             self.count += 1
+            if trace_id:
+                prev = self.exemplars.get(bi)
+                if prev is None or value > prev[0]:
+                    self.exemplars[bi] = (value, trace_id)
+
+    def exemplar_rows(self) -> List[Dict[str, Any]]:
+        """Exemplars as dicts, largest value first (dump/report shape)."""
+        with self._lock:
+            items = sorted(
+                self.exemplars.items(), key=lambda kv: kv[1][0], reverse=True
+            )
+        return [
+            {"bucket": bi, "value": v, "trace_id": t} for bi, (v, t) in items
+        ]
 
 
 class Registry:
@@ -183,10 +211,12 @@ class Registry:
             return
         self.gauge(name, **labels).set(value)
 
-    def observe(self, name: str, value: float, **labels) -> None:
+    def observe(
+        self, name: str, value: float, trace_id: Optional[str] = None, **labels
+    ) -> None:
         if not _enabled:
             return
-        self.histogram(name, **labels).observe(value)
+        self.histogram(name, **labels).observe(value, trace_id=trace_id)
 
     # -- spans ------------------------------------------------------------
 
@@ -202,6 +232,7 @@ class Registry:
         tid: int,
         depth: int,
         args: Optional[Dict[str, Any]] = None,
+        trace: Sequence[str] = (),
     ) -> None:
         rec = {
             "name": name,
@@ -211,9 +242,15 @@ class Registry:
             "depth": depth,
             "args": args or {},
         }
+        if trace:
+            rec["trace"] = list(trace)
         with self._lock:
             if len(self._spans) >= self.max_spans:
                 self.spans_dropped += 1
+                # visible drop signal: the plain attribute is easy to miss
+                # in dashboards; the counter rides every normal dump. The
+                # registry lock is an RLock, so self.inc under it is safe.
+                self.inc("obs.spans_dropped")
                 return
             self._spans.append(rec)
 
@@ -241,12 +278,15 @@ class Registry:
         for m in metrics:
             key = self._fmt_key(m.name, m.labels)
             if m.kind == "histogram":
-                out["histograms"][key] = {
+                h = {
                     "buckets": list(m.buckets),
                     "counts": list(m.counts),
                     "sum": m.sum,
                     "count": m.count,
                 }
+                if m.exemplars:
+                    h["exemplars"] = m.exemplar_rows()
+                out["histograms"][key] = h
             else:
                 out[m.kind + "s"][key] = m.value
         out["n_spans"] = n_spans
@@ -270,6 +310,8 @@ class Registry:
                     buckets=list(m.buckets), counts=list(m.counts),
                     sum=m.sum, count=m.count,
                 )
+                if m.exemplars:
+                    rec["exemplars"] = m.exemplar_rows()
             else:
                 rec["value"] = m.value
             stream.write(json.dumps(rec) + "\n")
@@ -345,7 +387,9 @@ def set_gauge(name: str, value: float, **labels) -> None:
     _default.set(name, value, **labels)
 
 
-def observe(name: str, value: float, **labels) -> None:
+def observe(
+    name: str, value: float, trace_id: Optional[str] = None, **labels
+) -> None:
     if not _enabled:
         return
-    _default.observe(name, value, **labels)
+    _default.observe(name, value, trace_id=trace_id, **labels)

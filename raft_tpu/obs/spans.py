@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Any, Iterator, Optional
 
-from raft_tpu.obs import metrics
+from raft_tpu.obs import metrics, request
 
 _tls = threading.local()
 
@@ -106,7 +106,10 @@ def span(name: str, **args) -> Iterator[Any]:
         dur = (time.perf_counter() - t0) * 1e6
         if st and st[-1] is s:
             st.pop()
-        reg.record_span(name, ts, dur, threading.get_ident(), depth, s.args)
+        reg.record_span(
+            name, ts, dur, threading.get_ident(), depth, s.args,
+            trace=request.current_trace(),
+        )
 
 
 def traced(name: Optional[str] = None, sync_result: bool = True):

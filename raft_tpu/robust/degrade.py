@@ -127,10 +127,14 @@ def sharded_search_degraded(
         else sharded_ann.sharded_ivf_pq_lists_search
     )
     # all-healthy uses the unmasked (pre-existing, bit-identical) program
-    d, i = search(
-        mesh, index, queries, k, params=params, axis=axis,
-        health=health if degraded else None, merge_mode=merge_mode, **kwargs,
-    )
+    with obs.span(
+        "robust.degraded_search", algo=algo, coverage=coverage,
+        n_healthy=n_healthy,
+    ) as sp:
+        d, i = sp.sync(search(
+            mesh, index, queries, k, params=params, axis=axis,
+            health=health if degraded else None, merge_mode=merge_mode, **kwargs,
+        ))
     return DegradedResult(
         distances=d, indices=i, coverage=coverage,
         degraded=degraded, failed_shards=failed,

@@ -93,6 +93,10 @@ class ServeResult:
     #: mutable-index generation the answer was computed against (0 for
     #: immutable registrations) — lets clients reason about freshness
     generation: int = 0
+    #: obs request trace ID ("" with the gate off) — resolves to the
+    #: request's spans/flow track in the Perfetto export and to its
+    #: histogram exemplars (docs/observability.md "Request traces")
+    trace_id: str = ""
 
     def __iter__(self):  # unpack like a plain (distances, indices)
         return iter((self.distances, self.indices))
@@ -164,6 +168,8 @@ class ServingEngine:
         self.maintenance_interval_ms = float(maintenance_interval_ms)
         self._last_maint = -float("inf")
         self._indexes: Dict[str, _Registration] = {}
+        #: per-index SLO trackers (see :meth:`set_slo` / :meth:`health`)
+        self._slos: Dict[str, obs.SloTracker] = {}
 
     # -- registration ------------------------------------------------------
 
@@ -345,6 +351,12 @@ class ServingEngine:
             t_arrival=now,
             deadline_s=(now + deadline_ms / 1e3) if deadline_ms is not None else None,
         )
+        if obs.is_enabled():
+            # trace identity is minted at admission: the synthetic
+            # serve.queue span starts here, and every span recorded under
+            # this request's dispatch carries the ID (obs/request.py)
+            req.trace_id = obs.new_trace_id()
+            req.t_submit_us = obs.registry().now_us()
         try:
             self.batcher.offer(req)
         except QueueFull:
@@ -396,6 +408,9 @@ class ServingEngine:
         for r in expired:
             obs.inc("serve.rejections", reason="deadline_expired",
                     index_id=r.group[0])
+            tracker = self._slos.get(r.group[0])
+            if tracker is not None:
+                tracker.record(ok=False)  # shed work burns the budget
         done = len(expired)
         if batch:
             self._dispatch(batch, now)
@@ -417,6 +432,79 @@ class ServingEngine:
 
     def queue_depth(self) -> int:
         return self.batcher.depth_rows()
+
+    # -- SLOs and health ---------------------------------------------------
+
+    def set_slo(
+        self,
+        index_id: str,
+        *,
+        latency_ms: Optional[float] = None,
+        target: float = 0.999,
+        window_s: float = 3600.0,
+        fast_window_s: float = 60.0,
+        slow_window_s: float = 300.0,
+        burn_threshold: float = 10.0,
+    ) -> obs.SloTracker:
+        """Declare a latency/availability objective for a registered
+        index. Every completed request records against it (a request is
+        *bad* when it errors, is shed past its deadline, or — with
+        ``latency_ms`` set — finishes slower than the threshold,
+        measured arrival→completion on the engine clock). The tracker
+        shares the engine's injectable clock, so virtual-time tests
+        drive burn-rate windows deterministically. Returns the tracker;
+        :meth:`health` surfaces its :meth:`~raft_tpu.obs.SloTracker.
+        evaluate` snapshot."""
+        self._reg(index_id)  # must be registered
+        tracker = obs.SloTracker(
+            obs.SLO(
+                index_id=index_id,
+                latency_ms=latency_ms,
+                target=target,
+                window_s=window_s,
+                fast_window_s=fast_window_s,
+                slow_window_s=slow_window_s,
+                burn_threshold=burn_threshold,
+            ),
+            clock=self.batcher.now,
+        )
+        self._slos[index_id] = tracker
+        return tracker
+
+    def health(self) -> Dict[str, object]:
+        """Structured health snapshot: queue + cache pressure, span-drop
+        signal, and per-index registration state with SLO budget/burn
+        status (``docs/serving.md``; the substrate the replicated-serving
+        and SLA-adaptive roadmap items read)."""
+        cache_stats = self.cache.stats()
+        out: Dict[str, object] = {
+            "queue": {
+                "depth_rows": self.batcher.depth_rows(),
+                "depth_requests": self.batcher.depth_requests(),
+                "capacity": self.batcher.capacity,
+            },
+            "cache": {
+                "hits": cache_stats.hits,
+                "misses": cache_stats.misses,
+                "evictions": cache_stats.evictions,
+                "size": cache_stats.size,
+            },
+            "obs": {
+                "enabled": obs.is_enabled(),
+                "spans_dropped": obs.registry().spans_dropped,
+            },
+            "indexes": {},
+        }
+        for index_id, reg in self._indexes.items():
+            entry: Dict[str, object] = {
+                "algo": reg.algo,
+                "mode": reg.mode,
+                "generation": max(reg.last_generation, 0),
+            }
+            tracker = self._slos.get(index_id)
+            entry["slo"] = tracker.evaluate().as_dict() if tracker else None
+            out["indexes"][index_id] = entry
+        return out
 
     # -- maintenance -------------------------------------------------------
 
@@ -576,6 +664,15 @@ class ServingEngine:
         key = ProgramKey(
             reg.index_id, reg.algo, bucket, k, params_key(reg.params), generation
         )
+        tracker = self._slos.get(reg.index_id)
+        # the batch's trace identities ride the dispatch thread: every
+        # span recorded below (dispatch, degrade, tiered fetch/refine)
+        # is tagged with them; NULL_SCOPE keeps the disabled path free of
+        # per-dispatch allocation
+        scope = (
+            obs.trace_scope(tuple(r.trace_id for r in batch))
+            if obs.is_enabled() else obs.NULL_SCOPE
+        )
         try:
             program = self.cache.get(
                 key, lambda: self._build_program(reg, bucket, k)
@@ -586,7 +683,7 @@ class ServingEngine:
                 index_id=reg.index_id, algo=reg.algo, bucket=bucket, rows=n,
             )
             t0 = time.perf_counter()
-            with obs.span(
+            with scope, obs.span(
                 "serve.dispatch", algo=reg.algo, bucket=bucket, rows=n, k=k
             ) as sp:
                 out = program(padded, snap) if snap is not None else program(padded)
@@ -602,6 +699,8 @@ class ServingEngine:
                     kind=type(e).__name__)
             for r in batch:
                 r.future.set_exception(e)
+                if tracker is not None:
+                    tracker.record(ok=False)
             return
         if obs.is_enabled():
             obs.inc("serve.batches", index_id=reg.index_id, algo=reg.algo)
@@ -610,12 +709,24 @@ class ServingEngine:
             if snap is not None:
                 obs.set_gauge("serve.generation", float(generation),
                               index_id=reg.index_id)
+        t_done = self.batcher.now() if tracker is not None else now
         off = 0
         for r in batch:
             m = r.n_rows
             tiq_ms = (now - r.t_arrival) * 1e3
             if obs.is_enabled():
-                obs.observe("serve.time_in_queue_ms", tiq_ms)
+                obs.observe("serve.time_in_queue_ms", tiq_ms,
+                            trace_id=r.trace_id or None)
+                if r.trace_id:
+                    # synthetic per-request queue span on its own track
+                    # (tid derived from req_id): the first hop of the
+                    # request's flow chain in the Perfetto export
+                    obs.registry().record_span(
+                        "serve.queue", r.t_submit_us, max(tiq_ms, 0.0) * 1e3,
+                        0x40000000 + (r.req_id % 0x3FFFFFFF), 0,
+                        {"index_id": reg.index_id, "rows": m},
+                        trace=(r.trace_id,),
+                    )
             r.future.set_result(
                 ServeResult(
                     distances=d_np[off : off + m],
@@ -627,6 +738,9 @@ class ServingEngine:
                     bucket=bucket,
                     batch_rows=n,
                     generation=generation,
+                    trace_id=r.trace_id,
                 )
             )
+            if tracker is not None:
+                tracker.record(latency_ms=(t_done - r.t_arrival) * 1e3)
             off += m

@@ -129,10 +129,13 @@ class TieredIndex:
     # -- stage 2+3: host gather + device re-rank -----------------------------
 
     def _refine(self, slab, queries, candidates, k: int):
-        return _refine_gathered_impl(
-            slab, queries, candidates,
-            k=k, metric=self.metric, metric_arg=self.metric_arg,
-        )
+        # span measures enqueue only (no sync): the pipeline owns the
+        # block point, and forcing one here would serialize the overlap
+        with obs.span("tiered.refine", nq=int(queries.shape[0]), k=int(k)):
+            return _refine_gathered_impl(
+                slab, queries, candidates,
+                k=k, metric=self.metric, metric_arg=self.metric_arg,
+            )
 
     def search(
         self,
@@ -164,50 +167,51 @@ class TieredIndex:
             obs.inc("tiered.search.calls", algo=self.algo)
             obs.inc("tiered.search.queries", float(nq))
 
-        if not overlap or len(spans) == 1:
-            outs = []
-            for s, e in spans:
-                qb = queries[s:e]
-                _, cand = self._scan(qb, kk, mode, **kwargs)
-                # Sequential (non-overlapped) tier: the documented fallback
-                # shape — the device idles during the host gather here by
-                # design, which is exactly what overlap=True removes.
-                cand_np = np.asarray(cand)  # graft-lint: ignore[sync-transfer-in-loop]
-                slab = self.store.gather(cand_np)
-                outs.append(self._refine(slab, qb, cand_np, k))
-            if obs.is_enabled():
-                obs.set_gauge("tiered.overlap_efficiency", 0.0)
-            return _collect(outs)
+        with obs.span("tiered.search", algo=self.algo, nq=int(nq), k=int(k)):
+            if not overlap or len(spans) == 1:
+                outs = []
+                for s, e in spans:
+                    qb = queries[s:e]
+                    _, cand = self._scan(qb, kk, mode, **kwargs)
+                    # Sequential (non-overlapped) tier: the documented fallback
+                    # shape — the device idles during the host gather here by
+                    # design, which is exactly what overlap=True removes.
+                    cand_np = np.asarray(cand)  # graft-lint: ignore[sync-transfer-in-loop]
+                    slab = self.store.gather(cand_np)
+                    outs.append(self._refine(slab, qb, cand_np, k))
+                if obs.is_enabled():
+                    obs.set_gauge("tiered.overlap_efficiency", 0.0)
+                return _collect(outs)
 
-        # Overlapped pipeline: scan i+1 is in flight while batch i's rows
-        # stream up from the host tier.
-        outs = [None] * len(spans)
-        fetch_s = [0.0] * len(spans)
-        hidden = [False] * len(spans)
-        scan_next = self._scan(queries[spans[0][0]:spans[0][1]], kk, mode, **kwargs)
-        for i, (s, e) in enumerate(spans):
-            scan_cur = scan_next
-            if i + 1 < len(spans):
-                ns, ne = spans[i + 1]
-                scan_next = self._scan(queries[ns:ne], kk, mode, **kwargs)
-            # the pipeline's one forced sync: batch i's candidate ids
-            cand_np = np.asarray(scan_cur[1])
-            t0 = time.perf_counter()
-            slab = self.store.gather(cand_np)
-            fetch_s[i] = time.perf_counter() - t0
-            outs[i] = self._refine(slab, queries[s:e], cand_np, k)
-            if i + 1 < len(spans):
-                # if the next scan is still running after the fetch, the
-                # fetch cost the pipeline nothing — probe without blocking
-                hidden[i] = not _is_ready(scan_next[1])
-        if obs.is_enabled():
-            total = sum(fetch_s)
-            eff = (
-                sum(f for f, h in zip(fetch_s, hidden) if h) / total
-                if total > _OVERLAP_EPS_S else 0.0
-            )
-            obs.set_gauge("tiered.overlap_efficiency", eff)
-        return _collect(outs)
+            # Overlapped pipeline: scan i+1 is in flight while batch i's
+            # rows stream up from the host tier.
+            outs = [None] * len(spans)
+            fetch_s = [0.0] * len(spans)
+            hidden = [False] * len(spans)
+            scan_next = self._scan(queries[spans[0][0]:spans[0][1]], kk, mode, **kwargs)
+            for i, (s, e) in enumerate(spans):
+                scan_cur = scan_next
+                if i + 1 < len(spans):
+                    ns, ne = spans[i + 1]
+                    scan_next = self._scan(queries[ns:ne], kk, mode, **kwargs)
+                # the pipeline's one forced sync: batch i's candidate ids
+                cand_np = np.asarray(scan_cur[1])
+                t0 = time.perf_counter()
+                slab = self.store.gather(cand_np)
+                fetch_s[i] = time.perf_counter() - t0
+                outs[i] = self._refine(slab, queries[s:e], cand_np, k)
+                if i + 1 < len(spans):
+                    # if the next scan is still running after the fetch, the
+                    # fetch cost the pipeline nothing — probe without blocking
+                    hidden[i] = not _is_ready(scan_next[1])
+            if obs.is_enabled():
+                total = sum(fetch_s)
+                eff = (
+                    sum(f for f, h in zip(fetch_s, hidden) if h) / total
+                    if total > _OVERLAP_EPS_S else 0.0
+                )
+                obs.set_gauge("tiered.overlap_efficiency", eff)
+            return _collect(outs)
 
 
 def _is_ready(arr) -> bool:

@@ -115,7 +115,8 @@ class HostVectorStore:
         ``refine._refine_impl``) -> ``[nq, n_cand, dim]`` staging slab.
 
         Counted in ``tiered.fetch.rows`` / ``tiered.fetch.bytes``, timed
-        into the ``tiered.fetch_ms`` histogram; crosses the
+        into the ``tiered.fetch_ms`` histogram and a ``host.fetch`` span
+        (trace-tagged when a request trace scope is active); crosses the
         ``host.fetch`` fault seam under retry."""
         c = np.asarray(candidates)
         expects(c.ndim == 2, "candidates must be [nq, n_cand]")
@@ -129,7 +130,8 @@ class HostVectorStore:
             return out
 
         try:
-            slab = retry_call(_fetch, policy=self._retry, op="host.fetch")
+            with obs.span("host.fetch", rows=int(safe.size), nq=int(c.shape[0])):
+                slab = retry_call(_fetch, policy=self._retry, op="host.fetch")
         except RetryError as e:
             raise HostFetchError(
                 "host-tier vector fetch failed",

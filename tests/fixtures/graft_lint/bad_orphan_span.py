@@ -1,0 +1,12 @@
+"""Seeded violation: a span opened under a name that the span taxonomy
+in ``docs/observability.md`` does not list — the trace reader sees a
+phase they cannot look up.
+
+Expected: exactly one ``orphan-span`` on the marked line.
+"""
+from raft_tpu import obs
+
+
+def phantom_phase(nq):
+    with obs.span("graftlint.fixture.phantom_span", nq=nq):  # LINT-HERE
+        return nq * 2

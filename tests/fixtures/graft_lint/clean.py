@@ -223,6 +223,15 @@ def record_dynamic_metric(obs, kind, value):
     obs.inc(name, value)
 
 
+def trace_documented_phase(obs, queries):
+    # orphan-span negative space: a documented taxonomy name is fine,
+    # and dynamic span names are outside the static taxonomy
+    with obs.span("host.fetch", rows=len(queries)):
+        phase = f"fixture.{len(queries)}.phase"
+        with obs.span(phase):
+            return queries
+
+
 # fault-point-drift negative space: every seam here is documented in
 # docs/robustness.md and exercised by the chaos tests
 FAULT_POINTS = (

@@ -1,0 +1,96 @@
+"""tools/bench_regress — the bench-history regression gate (ISSUE 12).
+
+Synthesizes BENCH_r*.json histories in tmp dirs and pins the CI
+contract: exit 0 clean, 1 on regression, 2 with no comparable data,
+``--smoke`` always 0; truncated tails yield only complete rows; rc!=0
+runs are skipped as baselines.
+"""
+import json
+import os
+
+from tools import bench_regress
+
+
+def _write(d, n, rc, rows=None, parsed=None, truncate_at=None):
+    tail = ""
+    if rows is not None:
+        tail = "log noise before the json\n" + json.dumps({"section": rows})
+        if truncate_at is not None:
+            tail = tail[:truncate_at]
+    with open(os.path.join(d, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump({"n": n, "cmd": "bench", "rc": rc, "tail": tail,
+                   "parsed": parsed}, f)
+
+
+def test_extract_rows_survives_truncation():
+    rows = [{"config": "a", "qps": 10.0}, {"config": "b", "qps": 20.0}]
+    tail = json.dumps({"s": rows})
+    # cut inside the second row: only the complete first row is recovered
+    cut = tail[: tail.index('"b"') + 2]
+    got = bench_regress.extract_rows(cut)
+    assert [r["config"] for r in got] == ["a"]
+    assert bench_regress.extract_rows("no json here") == []
+
+
+def test_regression_flagged_and_exit_codes(tmp_path):
+    d = str(tmp_path)
+    _write(d, 1, 0, rows=[{"config": "a", "qps": 100.0, "p99_ms": 2.0,
+                           "recall": 0.99}])
+    _write(d, 2, 0, rows=[{"config": "a", "qps": 120.0, "p99_ms": 1.8,
+                           "recall": 0.99}])
+    _write(d, 3, 0, rows=[{"config": "a", "qps": 50.0, "p99_ms": 5.0,
+                           "recall": 0.90}])
+    assert bench_regress.main(["--dir", d]) == 1
+    assert bench_regress.main(["--dir", d, "--smoke"]) == 0
+    # loosened thresholds pass the same history
+    assert bench_regress.main([
+        "--dir", d, "--qps-drop", "0.9", "--p99-rise", "9.0",
+        "--recall-drop", "0.5",
+    ]) == 0
+
+
+def test_clean_history_is_clean(tmp_path):
+    d = str(tmp_path)
+    _write(d, 1, 0, rows=[{"config": "a", "qps": 100.0, "p99_ms": 2.0}])
+    _write(d, 2, 0, rows=[{"config": "a", "qps": 98.0, "p99_ms": 2.1}])
+    assert bench_regress.main(["--dir", d]) == 0
+
+
+def test_no_data_exits_2(tmp_path):
+    d = str(tmp_path)
+    assert bench_regress.main(["--dir", d]) == 2          # no files at all
+    _write(d, 1, 0, rows=[{"config": "a", "qps": 100.0}])
+    assert bench_regress.main(["--dir", d]) == 2          # single run
+    assert bench_regress.main(["--dir", d, "--smoke"]) == 0
+
+
+def test_failed_runs_are_not_baselines(tmp_path):
+    d = str(tmp_path)
+    _write(d, 1, 0, rows=[{"config": "a", "qps": 100.0}])
+    # the rc!=0 run carries a catastrophic number that must be ignored
+    _write(d, 2, 1, rows=[{"config": "a", "qps": 1.0}])
+    _write(d, 3, 0, rows=[{"config": "a", "qps": 95.0}])
+    assert bench_regress.main(["--dir", d]) == 0
+
+
+def test_best_ever_catches_slow_drift(tmp_path):
+    d = str(tmp_path)
+    # each step is within the prior-run tolerance, but r4 vs best is not
+    for n, qps in ((1, 100.0), (2, 82.0), (3, 68.0), (4, 57.0)):
+        _write(d, n, 0, rows=[{"config": "a", "qps": qps}])
+    assert bench_regress.main(["--dir", d, "--qps-drop", "0.25"]) == 1
+
+
+def test_headline_metric_compared(tmp_path):
+    d = str(tmp_path)
+    head = {"metric": "best_qps", "unit": "qps"}
+    _write(d, 1, 0, parsed={**head, "value": 1000.0})
+    _write(d, 2, 0, parsed={**head, "value": 100.0})
+    assert bench_regress.main(["--dir", d]) == 1
+
+
+def test_repo_history_smoke():
+    """The gate must always parse this repo's own BENCH files (the
+    ``__graft_entry__`` dryrun wiring runs exactly this)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert bench_regress.main(["--dir", repo, "--smoke"]) == 0

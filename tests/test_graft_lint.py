@@ -40,6 +40,7 @@ BAD = {
     "bad_collective_divergence.py": "collective-divergence",
     "bad_metric_drift.py": "metric-drift",
     "bad_fault_point_drift.py": "fault-point-drift",
+    "bad_orphan_span.py": "orphan-span",
 }
 
 

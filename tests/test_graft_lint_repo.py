@@ -37,7 +37,7 @@ def test_new_rules_run_strict_and_clean():
     the emitted metric/fault-point namespaces."""
     strict = run_lint(TARGETS, select=[
         "lock-order", "collective-divergence",
-        "metric-drift", "fault-point-drift",
+        "metric-drift", "fault-point-drift", "orphan-span",
     ])
     assert not strict, "\n".join(v.render() for v in strict)
 

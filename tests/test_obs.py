@@ -400,3 +400,158 @@ def test_obs_report_cli(tmp_path, obs_on, capsys):
     out = capsys.readouterr().out
     assert "# obs report" in out and "root" in out
     assert obs_report.main([str(tmp_path / "missing.jsonl")]) == 1
+
+
+# -- request traces: IDs, exemplars, flow events (ISSUE 12) ------------------
+
+
+def test_disabled_request_api_is_inert():
+    obs.disable()
+    reg = obs.registry()
+    reg.reset()
+    assert obs.new_trace_id() == ""
+    assert obs.current_trace() == ()
+    with obs.NULL_SCOPE:
+        assert obs.current_trace() == ()
+    # nothing was allocated on the disabled path
+    assert reg._metrics == {} and reg.spans() == []
+
+
+def test_trace_ids_and_scope_nesting(obs_on):
+    t1, t2 = obs.new_trace_id(), obs.new_trace_id()
+    assert t1.startswith("t") and t2.startswith("t") and t1 != t2
+    assert obs.current_trace() == ()
+    with obs.trace_scope((t1, t2)):
+        assert obs.current_trace() == (t1, t2)
+        with obs.trace_scope((t2,)):  # inner binding wins
+            assert obs.current_trace() == (t2,)
+        assert obs.current_trace() == (t1, t2)
+    assert obs.current_trace() == ()
+    # empty/falsy ids are filtered out (the disabled-request shape)
+    with obs.trace_scope(("", t1)):
+        assert obs.current_trace() == (t1,)
+
+
+def test_spans_tagged_with_active_trace(obs_on):
+    tid = obs.new_trace_id()
+    with obs.span("untagged.phase"):
+        pass
+    with obs.trace_scope((tid,)):
+        with obs.span("tagged.phase", nq=1):
+            with obs.span("tagged.child"):
+                pass
+    spans = {s["name"]: s for s in obs_on.spans()}
+    assert "trace" not in spans["untagged.phase"]
+    assert spans["tagged.phase"]["trace"] == [tid]
+    assert spans["tagged.child"]["trace"] == [tid]
+    got = list(obs.iter_trace_spans(obs_on, tid))
+    assert [s["name"] for s in got] == ["tagged.phase", "tagged.child"]
+
+
+def test_histogram_exemplars_keep_worst_per_bucket(obs_on):
+    hist = obs_on.histogram("ex.ms", buckets=(1.0, 10.0))
+    hist.observe(0.5, trace_id="fast")
+    hist.observe(0.7, trace_id="faster-but-worse")  # same bucket, larger value
+    hist.observe(0.6, trace_id="not-retained")
+    hist.observe(50.0, trace_id="tail")
+    hist.observe(2.0)  # no trace: counted, no exemplar
+    rows = hist.exemplar_rows()
+    assert rows[0] == {"bucket": 2, "value": 50.0, "trace_id": "tail"}
+    assert {"bucket": 0, "value": 0.7, "trace_id": "faster-but-worse"} in rows
+    assert all(r["trace_id"] != "not-retained" for r in rows)
+    # the facade threads trace_id through to the histogram
+    obs.observe("ex2.ms", 3.0, trace_id="t1")
+    snap = obs_on.as_dict()
+    assert snap["histograms"]["ex2.ms"]["exemplars"] == [
+        {"bucket": obs_on.histogram("ex2.ms").counts.index(1), "value": 3.0,
+         "trace_id": "t1"}
+    ]
+    # histograms without exemplars do not grow the key
+    obs.observe("ex3.ms", 1.0)
+    assert "exemplars" not in snap["histograms"].get("ex3.ms", {})
+
+
+def test_exemplars_jsonl_round_trip(obs_on):
+    obs.observe("rt.ms", 42.0, trace_id="tX")
+    buf = io.StringIO()
+    obs_on.dump_jsonl(buf)
+    recs = [json.loads(line) for line in buf.getvalue().splitlines()]
+    h = next(r for r in recs if r["kind"] == "histogram")
+    assert h["exemplars"][0]["trace_id"] == "tX"
+    assert h["exemplars"][0]["value"] == 42.0
+
+
+def test_flow_events_round_trip(tmp_path, obs_on):
+    tid = obs.new_trace_id()
+    lone = obs.new_trace_id()
+    with obs.trace_scope((tid,)):
+        with obs.span("flow.a"):
+            with obs.span("flow.b"):
+                pass
+    with obs.trace_scope((lone,)):
+        with obs.span("flow.single"):  # 1 span: no flow chain emitted
+            pass
+    path = obs.write_trace(str(tmp_path / "trace.json"))
+    doc = obs.load_trace(path)  # validate_trace accepts s/t/f events
+    flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "t", "f")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert len({e["id"] for e in flows}) == 1  # one chain, stable id
+    fin = next(e for e in flows if e["ph"] == "f")
+    assert fin["bp"] == "e"
+    xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert xs["flow.a"]["args"]["trace"] == [tid]
+    assert xs["flow.single"]["args"]["trace"] == [lone]
+
+
+def test_validate_trace_rejects_malformed_flow_events():
+    base = {"name": "request", "pid": 1, "tid": 1, "ts": 0.0}
+    obs.validate_trace({"traceEvents": [{"ph": "s", "id": 7, **base}]})
+    with pytest.raises(ValueError):
+        obs.validate_trace({"traceEvents": [{"ph": "s", **base}]})  # no id
+    with pytest.raises(ValueError):
+        obs.validate_trace(
+            {"traceEvents": [{"ph": "t", "id": True, **base}]}  # bool id
+        )
+
+
+def test_span_ring_overflow_counts_dropped_metric(obs_on):
+    reg = obs.Registry(max_spans=2)
+    for _ in range(5):
+        reg.record_span("s", 0.0, 1.0, 0, 0)
+    assert reg.spans_dropped == 3
+    assert reg.as_dict()["counters"]["obs.spans_dropped"] == 3.0
+
+
+def test_obs_report_notes_dropped_spans(tmp_path, obs_on):
+    from tools import obs_report
+
+    reg = obs.Registry(max_spans=1)
+    for _ in range(3):
+        reg.record_span("tiny", 0.0, 1.0, 0, 0)
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w") as f:
+        reg.dump_jsonl(f)
+    report = obs_report.render_report(str(path))
+    assert "2 span(s) dropped" in report
+    assert "undercount" in report
+
+
+def test_obs_report_tail_attribution(tmp_path, obs_on):
+    from tools import obs_report
+
+    slow, fast = obs.new_trace_id(), obs.new_trace_id()
+    for tid, fetch_s in ((slow, 0.02), (fast, 0.001)):
+        with obs.trace_scope((tid,)):
+            with obs.span("req.root"):
+                with obs.span("req.fetch"):
+                    import time as _t
+                    _t.sleep(fetch_s)
+        obs.observe("req.latency_ms", 30.0 if tid == slow else 2.0,
+                    trace_id=tid)
+    path = obs.write_metrics_jsonl(str(tmp_path / "metrics.jsonl"))
+    report = obs_report.render_report(path)
+    assert "tail attribution" in report
+    assert slow in report
+    # the injected-latency phase dominates the slow trace's self-time
+    row_line = next(ln for ln in report.splitlines() if slow in ln)
+    assert "req.fetch" in row_line

@@ -717,3 +717,175 @@ class TestSubmitValidation:
         eng.run_until_idle()
         rows = [f.result().indices.shape[0] for f in futs]
         assert rows == [4, 4, 2]
+
+
+# -- request tracing + SLOs (ISSUE 12) ---------------------------------------
+
+
+class TestRequestObservability:
+    def test_disabled_gate_zero_allocation_and_empty_trace(self, corpus, indexes):
+        """With obs off (the autouse default) the trace plumbing must
+        allocate nothing and change nothing: no trace IDs on results,
+        no spans, no metric objects."""
+        assert not obs.is_enabled()
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        fut = eng.submit("bf", Q[:4], k=10)
+        eng.run_until_idle()
+        res = fut.result()
+        assert res.trace_id == ""
+        reg = obs.registry()
+        assert reg._metrics == {} and reg.spans() == []
+        assert obs.new_trace_id() == "" and obs.current_trace() == ()
+
+    def test_every_completed_request_carries_a_distinct_trace(
+        self, corpus, indexes, serve_obs
+    ):
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        futs = [eng.submit("bf", Q[i : i + 1], k=10) for i in range(4)]
+        eng.run_until_idle()
+        ids = [f.result().trace_id for f in futs]
+        assert all(t.startswith("t") for t in ids)
+        assert len(set(ids)) == 4
+        # each trace resolves to its queue wait + the dispatch it rode
+        for t in ids:
+            names = [s["name"] for s in obs.iter_trace_spans(serve_obs, t)]
+            assert "serve.queue" in names and "serve.dispatch" in names
+
+    def test_chaos_trace_resolves_full_tiered_chain(
+        self, tmp_path, corpus, serve_obs
+    ):
+        """The ISSUE-12 acceptance drill: inject latency at the
+        ``host.fetch`` seam under a *warmed* engine (so compile time
+        does not drown the injected seam), then prove the slowest
+        request's exemplar resolves to the complete queue -> dispatch ->
+        fetch -> refine chain and that tail attribution names the
+        injected seam as the dominant phase."""
+        from tools import obs_report
+        from raft_tpu.tiered import HostVectorStore, TieredIndex
+
+        X, Q = corpus
+        bf = brute_force.build(X)
+        tidx = TieredIndex("brute_force", bf, HostVectorStore(X),
+                          refine_ratio=4)
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register("t", "tiered", tidx)
+        eng.set_slo("t", latency_ms=200.0, target=0.9)
+
+        # warm-up: compile every program this test will dispatch
+        for _ in range(2):
+            fut = eng.submit("t", Q[:4], k=10)
+            eng.run_until_idle()
+            fut.result()
+        serve_obs.reset()  # drop warm-up spans; keep the drill clean
+
+        faults.enable()
+        with faults.injected("host.fetch", latency_s=0.05):
+            futs = [eng.submit("t", Q[i * 4 : i * 4 + 4], k=10)
+                    for i in range(2)]
+            eng.run_until_idle()
+            results = [f.result() for f in futs]
+        worst = max(results, key=lambda r: r.time_in_queue_ms).trace_id
+        names = [s["name"] for s in obs.iter_trace_spans(serve_obs, worst)]
+        for expected in ("serve.queue", "serve.dispatch", "tiered.search",
+                        "host.fetch", "tiered.refine"):
+            assert expected in names, (expected, names)
+
+        # offline: the report's tail-attribution row blames host.fetch
+        mpath = obs.write_metrics_jsonl(str(tmp_path / "metrics.jsonl"))
+        report = obs_report.render_report(mpath)
+        assert "tail attribution" in report
+        tail_lines = [ln for ln in report.splitlines() if worst in ln]
+        assert tail_lines and "host.fetch" in tail_lines[0]
+
+    def test_flow_events_in_perfetto_export(self, tmp_path, corpus, indexes,
+                                            serve_obs):
+        _X, Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        fut = eng.submit("bf", Q[:2], k=10)
+        eng.run_until_idle()
+        tid = fut.result().trace_id
+        doc = obs.load_trace(obs.write_trace(str(tmp_path / "t.json")))
+        flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "t", "f")]
+        assert {"s", "f"} <= {e["ph"] for e in flows}
+        tagged = [e for e in doc["traceEvents"]
+                  if e["ph"] == "X" and e.get("args", {}).get("trace") == [tid]]
+        assert {e["name"] for e in tagged} >= {"serve.queue", "serve.dispatch"}
+
+
+class TestSlo:
+    def _engine(self, corpus, indexes, clock):
+        _X, _Q = corpus
+        idx, params, mode, kw = indexes["brute_force"]
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0, clock=clock)
+        eng.register("bf", "brute_force", idx, params=params, mode=mode, **kw)
+        return eng
+
+    def test_burn_rate_fires_and_clears_on_virtual_time(self):
+        clk = VClock(100.0)
+        tracker = obs.SloTracker(
+            obs.SLO(index_id="i", latency_ms=10.0, target=0.9,
+                    fast_window_s=10.0, slow_window_s=60.0,
+                    burn_threshold=5.0),
+            clock=clk,
+        )
+        # healthy traffic: no alert
+        for _ in range(20):
+            tracker.record(latency_ms=1.0)
+            clk.advance(0.5)
+        st = tracker.evaluate()
+        assert not st.alerting and st.burn_fast == 0.0
+        # injected latency: every request breaches -> burn 1/(1-0.9) = 10x
+        for _ in range(20):
+            tracker.record(latency_ms=50.0)
+            clk.advance(0.5)
+        st = tracker.evaluate()
+        assert st.alerting and st.alerts_fired == 1
+        assert st.burn_fast >= 5.0 and st.burn_slow >= 5.0
+        # recovery: fast window drains below threshold -> alert clears
+        for _ in range(40):
+            tracker.record(latency_ms=1.0)
+            clk.advance(0.5)
+        st = tracker.evaluate()
+        assert not st.alerting and st.alerts_cleared == 1
+        # the incident consumed budget: 20 bad of 80 against a 10% budget
+        # is overspent — remaining goes negative rather than saturating
+        assert st.budget_remaining < 0.0
+        assert st.requests == 80 and st.bad == 20
+
+    def test_engine_health_reflects_budget_state(self, corpus, indexes,
+                                                 serve_obs):
+        clk = VClock(50.0)
+        eng = self._engine(corpus, indexes, clock=clk)
+        _X, Q = corpus
+        eng.set_slo("bf", latency_ms=1000.0, target=0.9)
+        h = eng.health()
+        assert h["queue"]["depth_requests"] == 0
+        assert h["obs"]["enabled"] is True
+        slo = h["indexes"]["bf"]["slo"]
+        assert slo["requests"] == 0 and slo["budget_remaining"] == 1.0
+        # completions on a virtual clock are instant -> all good
+        fut = eng.submit("bf", Q[:2], k=10)
+        eng.run_until_idle()
+        fut.result()
+        slo = eng.health()["indexes"]["bf"]["slo"]
+        assert slo["requests"] >= 1 and slo["bad"] == 0
+        assert slo["budget_remaining"] == 1.0 and not slo["alerting"]
+        # an expired request consumes budget through the same tracker
+        eng.submit("bf", Q[:1], k=10, deadline_ms=50.0)
+        clk.advance(1.0)
+        eng.step(force=True)
+        slo = eng.health()["indexes"]["bf"]["slo"]
+        assert slo["bad"] >= 1 and slo["budget_remaining"] < 1.0
+
+    def test_slo_requires_registered_index(self, corpus, indexes):
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        with pytest.raises(RaftError):
+            eng.set_slo("ghost", latency_ms=10.0)

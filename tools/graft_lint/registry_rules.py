@@ -20,6 +20,12 @@ failure:
   ``docs/observability.md``. Dynamic names (variables, f-strings) are
   out of scope — the doc table documents the static namespace.
 
+* ``orphan-span`` — same contract for span names: a string literal
+  passed to ``obs.span(...)`` / ``record_span(...)`` must appear in
+  the span taxonomy in ``docs/observability.md``. Tail-attribution
+  reports and Perfetto traces are read by name; an undocumented span
+  is a phase nobody can look up.
+
 Both rules locate the repo root by walking up from the linted file to
 a directory containing ``docs/``; files outside any such layout are
 skipped (the rules are about *this* repo's contract, not a general
@@ -35,6 +41,9 @@ from tools.graft_lint.core import Checker, LintModule, Violation
 
 #: obs-facade emitters whose first positional argument is a metric name
 _EMITTERS = frozenset({"inc", "observe", "set_gauge"})
+
+#: span creators whose first positional argument is a span name
+_SPAN_CALLEES = frozenset({"span", "record_span"})
 
 
 def _repo_root(path: str) -> Optional[str]:
@@ -180,4 +189,43 @@ class MetricDriftChecker(Checker):
                 )
 
 
-CHECKERS = [FaultPointDriftChecker(), MetricDriftChecker()]
+class OrphanSpanChecker(Checker):
+    rule = "orphan-span"
+    doc = (
+        "span name passed to obs.span/record_span but absent from the "
+        "span taxonomy in docs/observability.md — traces and "
+        "tail-attribution reports are read by name; an undocumented "
+        "span is a phase nobody can look up"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        root = None
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name not in _SPAN_CALLEES or not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                continue  # dynamic span names are out of the static taxonomy
+            span_name = arg.value
+            if root is None:
+                root = _repo_root(module.path) or ""
+            if not root:
+                return
+            doc = _corpus.doc_text(root, "observability.md")
+            if doc is None or span_name not in doc:
+                yield self.violation(
+                    module, node,
+                    f"span '{span_name}' is not documented in "
+                    "docs/observability.md — add it to the span taxonomy "
+                    "(name, phase, what the duration covers) so trace "
+                    "readers can look the phase up",
+                )
+
+
+CHECKERS = [FaultPointDriftChecker(), MetricDriftChecker(), OrphanSpanChecker()]

@@ -31,7 +31,10 @@ def parse_file(path: str) -> Dict[str, Any]:
     """Parse one artifact into ``{"spans": [...], "counters": {...},
     "gauges": {...}, "histograms": {...}}``. JSONL snapshots carry all
     four; Chrome traces carry spans (ph "X") and counters (ph "C")."""
-    out: Dict[str, Any] = {"spans": [], "counters": {}, "gauges": {}, "histograms": {}}
+    out: Dict[str, Any] = {
+        "spans": [], "counters": {}, "gauges": {}, "histograms": {},
+        "spans_dropped": 0,
+    }
     if path.endswith(".jsonl"):
         with open(path, "r", encoding="utf-8") as f:
             for line in f:
@@ -47,6 +50,7 @@ def parse_file(path: str) -> Dict[str, Any]:
                             "ts": float(rec["ts_us"]),
                             "dur": float(rec["dur_us"]),
                             "tid": rec.get("tid", 0),
+                            "trace": rec.get("trace") or [],
                         }
                     )
                 elif kind in ("counter", "gauge"):
@@ -55,19 +59,25 @@ def parse_file(path: str) -> Dict[str, Any]:
                     out["histograms"][_key(rec)] = {
                         "count": rec.get("count", 0),
                         "sum": rec.get("sum", 0.0),
+                        "exemplars": rec.get("exemplars") or [],
                     }
         return out
     with open(path, "r", encoding="utf-8") as f:
         doc = json.load(f)
+    out["spans_dropped"] = int(
+        (doc.get("otherData") or {}).get("spans_dropped", 0) or 0
+    )
     for ev in doc.get("traceEvents", []):
         ph = ev.get("ph")
         if ph == "X":
+            args = ev.get("args") or {}
             out["spans"].append(
                 {
                     "name": ev["name"],
                     "ts": float(ev["ts"]),
                     "dur": float(ev["dur"]),
                     "tid": ev.get("tid", 0),
+                    "trace": args.get("trace") or [],
                 }
             )
         elif ph == "C":
@@ -139,6 +149,51 @@ def aggregate(per_span: List[Tuple[str, float, float]]) -> List[Dict[str, Any]]:
     return sorted(agg.values(), key=lambda r: -r["self_us"])
 
 
+def tail_attribution(
+    spans: List[Dict[str, Any]],
+    histograms: Dict[str, Dict[str, Any]],
+    top: int = 3,
+) -> List[Dict[str, Any]]:
+    """Attribute the slowest exemplar traces to their per-phase self-time.
+
+    Histogram exemplars name concrete request traces; for the ``top``
+    worst (largest exemplar value, deduped by trace ID) this resolves
+    each trace's spans and runs the same self-time sweep restricted to
+    them, answering "where did THIS p99 request spend its time — queue,
+    dispatch, fetch, refine?". Returns one row per trace:
+    ``{trace, source, value, dominant, phases: [(name, self_us), ...]}``.
+    """
+    exemplars: List[Tuple[float, str, str]] = []
+    for hname, h in histograms.items():
+        for e in h.get("exemplars", []):
+            tid = e.get("trace_id")
+            if tid:
+                exemplars.append((float(e.get("value", 0.0)), str(tid), hname))
+    exemplars.sort(key=lambda x: -x[0])
+    rows: List[Dict[str, Any]] = []
+    seen = set()
+    for value, trace_id, hname in exemplars:
+        if trace_id in seen:
+            continue
+        seen.add(trace_id)
+        tspans = [s for s in spans if trace_id in (s.get("trace") or [])]
+        if not tspans:
+            continue
+        agg = aggregate(self_times(tspans))
+        rows.append(
+            {
+                "trace": trace_id,
+                "source": hname,
+                "value": value,
+                "dominant": agg[0]["name"],
+                "phases": [(r["name"], r["self_us"]) for r in agg],
+            }
+        )
+        if len(rows) >= top:
+            break
+    return rows
+
+
 def _table(rows: List[List[str]], header: List[str]) -> str:
     widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
     def fmt(r):
@@ -155,6 +210,7 @@ def render_report(*paths: str, top: int = 10) -> str:
     counters: Dict[str, float] = {}
     gauges: Dict[str, float] = {}
     histograms: Dict[str, Dict[str, Any]] = {}
+    spans_dropped = 0
     for path in paths:
         if not path:
             continue
@@ -167,6 +223,11 @@ def render_report(*paths: str, top: int = 10) -> str:
             gauges = parsed["gauges"]
         if parsed["histograms"] and not histograms:
             histograms = parsed["histograms"]
+        spans_dropped = max(spans_dropped, parsed.get("spans_dropped", 0))
+    # the counter rides JSONL dumps; otherData rides traces — take either
+    spans_dropped = max(
+        spans_dropped, int(counters.get("obs.spans_dropped", 0))
+    )
 
     sections: List[str] = ["# obs report"]
     if spans:
@@ -177,8 +238,26 @@ def render_report(*paths: str, top: int = 10) -> str:
              f"{r['total_us'] / 1e3 / r['count']:.2f}"]
             for r in agg
         ]
-        sections.append(f"## top {len(rows)} spans by self-time\n"
-                        + _table(rows, ["span", "count", "self_ms", "total_ms", "mean_ms"]))
+        section = (f"## top {len(rows)} spans by self-time\n"
+                   + _table(rows, ["span", "count", "self_ms", "total_ms", "mean_ms"]))
+        if spans_dropped:
+            section += (
+                f"\n(! {spans_dropped} span(s) dropped at the registry cap — "
+                "totals undercount; raise Registry(max_spans=) or reset "
+                "between phases)"
+            )
+        sections.append(section)
+    tail = tail_attribution(spans, histograms)
+    if tail:
+        rows = [
+            [r["trace"], r["source"], f"{r['value']:.2f}", r["dominant"],
+             "; ".join(f"{n} {s / 1e3:.2f}ms" for n, s in r["phases"][:5])]
+            for r in tail
+        ]
+        sections.append(
+            "## tail attribution (slowest exemplar traces)\n"
+            + _table(rows, ["trace", "exemplar_of", "value", "dominant", "self-time breakdown"])
+        )
     # search-path routing gets its own table: the per-mode dispatch
     # counters (fused / scan / probe, lut="rabitq" vs nibble/f32, the
     # delta segment's fused-vs-exact route) answer the first question a
