@@ -183,6 +183,9 @@ class WriteAheadLog:
         self._offset = offset  # write cursor within the active segment
         self._max_bytes = max_bytes
         self._seq = seq
+        #: durable records in this log (recovered at open + appended
+        #: since) — the leader side of replica staleness accounting
+        self._records = 0
 
     @classmethod
     def open(
@@ -223,7 +226,9 @@ class WriteAheadLog:
             os.fsync(fh.fileno())
         if obs.is_enabled() and records:
             obs.inc("mutable.wal.replayed", float(len(records)))
-        return cls(path, fh, good, max_bytes=max_bytes, seq=seq), records
+        log = cls(path, fh, good, max_bytes=max_bytes, seq=seq)
+        log._records = len(records)
+        return log, records
 
     @property
     def offset(self) -> int:
@@ -237,6 +242,40 @@ class WriteAheadLog:
     def segment_paths(self) -> List[str]:
         """Existing segment files of this log, sequence order."""
         return segment_paths(self.path)
+
+    def record_count(self) -> int:
+        """Durable records in this log: the valid prefix recovered at
+        :meth:`open` plus everything appended since. Replication reads
+        this as the leader high-water mark when computing
+        ``replica.staleness_records`` (``docs/replication.md``)."""
+        return self._records
+
+    def seal(self) -> bool:
+        """Explicitly seal the active segment so its frames become
+        shippable (:mod:`raft_tpu.replica.shipping` never reads the
+        active segment — only sealed ones, which are immutable and end
+        on a frame boundary). A no-op on an empty active segment:
+        rotating then would mint empty sealed files. Returns True when
+        a rotation actually happened. Counted in ``mutable.wal.seals``."""
+        if self._offset == 0:
+            return False
+        self._rotate()
+        if obs.is_enabled():
+            obs.inc("mutable.wal.seals")
+        return True
+
+    def sealed_segments(self) -> List[Tuple[int, str]]:
+        """The immutable ``(seq, path)`` segments of this log — every
+        on-disk segment strictly before the active one. Sealed segments
+        were flushed + fsync'd at rotation and are never written again,
+        so a shipper may read them without racing :meth:`append`; a torn
+        frame found in one is transport/storage damage, never an
+        in-progress write."""
+        return [
+            (sq, sp)
+            for sq, sp in _list_segments(self.path)
+            if sq < self._seq and os.path.exists(sp)
+        ]
 
     def position(self) -> Tuple[int, int]:
         """The durable high-water mark ``(segment, offset)`` — always a
@@ -319,6 +358,7 @@ class WriteAheadLog:
         self._fh.flush()
         os.fsync(self._fh.fileno())
         self._offset += len(frame)
+        self._records += 1
         # ... and a crash after the fsync leaves it durable but
         # unacknowledged (post-state on recovery)
         faults.fire("wal.append", op=record.op, stage="post")

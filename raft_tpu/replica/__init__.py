@@ -1,0 +1,36 @@
+"""Replicated serving: health-routed replica groups with WAL shipping.
+
+The pieces (see ``docs/replication.md`` for the full story):
+
+* :class:`~raft_tpu.replica.group.ReplicaGroup` — N engine-backed
+  copies of every registered index behind the single-engine futures
+  API, with circuit-breaker health routing and failover that
+  **re-queues** in-flight work instead of erroring it;
+* :class:`~raft_tpu.replica.router.Router` — least-queue-depth
+  admission over breaker-closed, staleness-bounded replicas;
+* :mod:`~raft_tpu.replica.shipping` — leader WAL seal → CRC-verified
+  segment shipping → follower replay, with bounded-staleness
+  accounting (:class:`Replication`, :class:`Shipper`,
+  :class:`Follower`, :class:`ShipRejected`).
+"""
+from raft_tpu.replica.group import ReplicaGroup
+from raft_tpu.replica.router import Router
+from raft_tpu.replica.shipping import (
+    DEFAULT_CHUNK_BYTES,
+    Follower,
+    FollowerPosition,
+    Replication,
+    Shipper,
+    ShipRejected,
+)
+
+__all__ = [
+    "DEFAULT_CHUNK_BYTES",
+    "Follower",
+    "FollowerPosition",
+    "ReplicaGroup",
+    "Replication",
+    "Router",
+    "ShipRejected",
+    "Shipper",
+]

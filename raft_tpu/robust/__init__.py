@@ -30,6 +30,7 @@ from raft_tpu.robust.fallback import (
 )
 from raft_tpu.robust.retry import (
     DEFAULT_POLICY,
+    CircuitBreaker,
     RetryError,
     RetryPolicy,
     retry_call,
@@ -37,6 +38,7 @@ from raft_tpu.robust.retry import (
 )
 
 __all__ = [
+    "CircuitBreaker",
     "DEFAULT_POLICY",
     "DegradedResult",
     "FALLBACK_ERRORS",

@@ -85,6 +85,9 @@ FAULT_POINTS = (
     "compact.flip",           # mutable/maintenance.py after replay, pre-swap
     "compact.worker",         # mutable/maintenance.py worker loop (thread death)
     "host.fetch",             # tiered/store.py host-tier candidate gather
+    "replica.dispatch",       # replica/group.py per-replica pump (before engine.step)
+    "wal.ship",               # replica/shipping.py sealed-frame transfer to a follower
+    "replica.apply",          # replica/shipping.py follower replay of a shipped chunk
 )
 
 

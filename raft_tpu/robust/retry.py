@@ -107,6 +107,116 @@ def retry_call(
     raise RetryError(op, policy.max_attempts, last) from last
 
 
+#: gauge encoding of breaker states (robust.breaker.state{target})
+_BREAKER_STATE_VALUES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker (closed → open → half-open).
+
+    The dispatch-health state machine the replica router runs per
+    replica (:mod:`raft_tpu.replica.router`), factored here because it
+    is generic: ``failure_threshold`` *consecutive* failures trip the
+    breaker OPEN; after ``reset_timeout_s`` (on the injectable
+    ``clock``) one caller's :meth:`allow` transitions it HALF_OPEN and
+    admits exactly one probe; the probe's :meth:`record_success` closes
+    the breaker, its :meth:`record_failure` re-opens it and re-arms the
+    timer. Any success in CLOSED resets the consecutive-failure count.
+
+    State is exported as the ``robust.breaker.state{target}`` gauge
+    (0 = closed, 1 = half_open, 2 = open) and every transition bumps
+    ``robust.breaker.transitions{target, to}``. The breaker is
+    deliberately lock-free: it is owned by one pump/dispatch thread,
+    with :meth:`allow` racing at worst one misrouted admission — which
+    the failover path re-queues anyway.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(
+        self,
+        target: str,
+        *,
+        failure_threshold: int = 3,
+        reset_timeout_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        expects(failure_threshold >= 1, "failure_threshold must be >= 1")
+        expects(reset_timeout_s >= 0.0, "reset_timeout_s must be >= 0")
+        self.target = str(target)
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self._state = self.CLOSED
+        self._failures = 0  # consecutive failures since the last success
+        self._opened_at = 0.0
+        self._emit_state()
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def failures(self) -> int:
+        """Consecutive failures since the last recorded success."""
+        return self._failures
+
+    def _emit_state(self) -> None:
+        if obs.is_enabled():
+            obs.set_gauge(
+                "robust.breaker.state",
+                _BREAKER_STATE_VALUES[self._state],
+                target=self.target,
+            )
+
+    def _transition(self, to: str) -> None:
+        if to == self._state:
+            return
+        self._state = to
+        obs.inc("robust.breaker.transitions", target=self.target, to=to)
+        self._emit_state()
+
+    def allow(self) -> bool:
+        """May a dispatch proceed against this target right now?
+
+        CLOSED always admits. OPEN admits nothing until
+        ``reset_timeout_s`` has elapsed, then flips HALF_OPEN and admits
+        the calling dispatch as the probe. HALF_OPEN admits nothing
+        further while the probe is outstanding.
+        """
+        if self._state == self.CLOSED:
+            return True
+        if self._state == self.OPEN:
+            if self._clock() - self._opened_at >= self.reset_timeout_s:
+                self._transition(self.HALF_OPEN)
+                return True
+            return False
+        return False  # HALF_OPEN: the single probe is already out
+
+    def record_success(self) -> None:
+        """A dispatch (or the half-open probe) succeeded."""
+        self._failures = 0
+        if self._state != self.CLOSED:
+            self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        """A dispatch failed (or timed out). Trips the breaker at
+        ``failure_threshold`` consecutive failures; a half-open probe
+        failure re-opens immediately and re-arms the reset timer."""
+        self._failures += 1
+        if self._state == self.HALF_OPEN or (
+            self._state == self.CLOSED and self._failures >= self.failure_threshold
+        ):
+            self._opened_at = self._clock()
+            self._transition(self.OPEN)
+        elif self._state == self.OPEN:
+            # repeated failures while open (e.g. a failed probe window)
+            # keep pushing the retry horizon out
+            self._opened_at = self._clock()
+
+
 def retrying(policy: RetryPolicy = DEFAULT_POLICY, op: Optional[str] = None, seed: int = 0):
     """Decorator form of :func:`retry_call`."""
 

@@ -279,6 +279,19 @@ class MicroBatcher:
             )
         return batch, expired
 
+    def drain_requests(self) -> List[Request]:
+        """Remove and return every queued request WITHOUT completing
+        their futures. Replica failover (:mod:`raft_tpu.replica`) uses
+        this to evacuate a dead replica's queue: the requests are
+        re-submitted on a healthy engine and their *group*-level futures
+        complete there — the engine-level futures drained here are
+        intentionally abandoned."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue = deque(maxlen=self.capacity)
+            self._rows = 0
+        return out
+
     def drain_expired(self, now: Optional[float] = None) -> List[Request]:
         """Reject (only) the expired requests without forming a batch."""
         if now is None:

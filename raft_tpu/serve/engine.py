@@ -328,11 +328,15 @@ class ServingEngine:
         queries,
         k: int,
         deadline_ms: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> ServeFuture:
         """Enqueue one request (``queries`` [m, dim] or a single [dim]
         row) and return its future. Raises :class:`QueueFull` /
         :class:`DeadlineExceeded` at admission — rejected work never
-        occupies the queue."""
+        occupies the queue. ``trace_id`` adopts an existing obs trace
+        instead of minting one — how a replica group keeps one identity
+        on a request across failover re-submissions
+        (``docs/replication.md``)."""
         reg = self._reg(index_id)
         q = np.asarray(queries)
         if q.ndim == 1:
@@ -355,7 +359,7 @@ class ServingEngine:
             # trace identity is minted at admission: the synthetic
             # serve.queue span starts here, and every span recorded under
             # this request's dispatch carries the ID (obs/request.py)
-            req.trace_id = obs.new_trace_id()
+            req.trace_id = trace_id or obs.new_trace_id()
             req.t_submit_us = obs.registry().now_us()
         try:
             self.batcher.offer(req)
@@ -432,6 +436,18 @@ class ServingEngine:
 
     def queue_depth(self) -> int:
         return self.batcher.depth_rows()
+
+    def evict_queued(self) -> List[Request]:
+        """Evacuate every queued request without completing its future
+        (see :meth:`~raft_tpu.serve.batcher.MicroBatcher.drain_requests`).
+        The replica layer calls this when this engine's replica is
+        declared dead, then re-queues the evicted work on a healthy
+        replica — the queue must not keep rows a failed engine will
+        never serve."""
+        out = self.batcher.drain_requests()
+        if obs.is_enabled():
+            obs.set_gauge("serve.queue_depth", self.batcher.depth_rows())
+        return out
 
     # -- SLOs and health ---------------------------------------------------
 

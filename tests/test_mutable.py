@@ -228,6 +228,50 @@ class TestWalRotation:
         mut2.close()
 
 
+class TestWalSeal:
+    """Explicit sealing (replication's shippable-frame boundary)."""
+
+    def test_seal_rotates_at_a_frame_boundary(self, rng, tmp_path):
+        path = str(tmp_path / "wal.log")
+        wal, _ = WriteAheadLog.open(path)
+        assert wal.seal() is False  # empty active segment: nothing to seal
+        assert wal.sealed_segments() == []
+        for i in range(3):
+            wal.append(WalRecord(op="insert", ids=np.array([i], np.int64),
+                                 vectors=_rows(rng, 1)))
+        assert wal.seal() is True
+        sealed = wal.sealed_segments()
+        assert [sq for sq, _ in sealed] == [0]
+        # the sealed file ends on a whole frame and replays completely
+        _, good = replay(sealed[0][1])
+        assert good == os.path.getsize(sealed[0][1])
+        assert wal.segment == 1 and wal.offset == 0
+        assert wal.seal() is False  # still nothing new to seal
+        # appends land in the new active segment; a second seal ships them
+        wal.append(WalRecord(op="delete", ids=np.array([0], np.int64)))
+        assert wal.seal() is True
+        assert [sq for sq, _ in wal.sealed_segments()] == [0, 1]
+        wal.close()
+        _, recs = WriteAheadLog.open(path)
+        assert [r.op for r in recs] == ["insert"] * 3 + ["delete"]
+
+    def test_record_count_tracks_durable_records(self, rng, tmp_path):
+        path = str(tmp_path / "wal.log")
+        wal, _ = WriteAheadLog.open(path)
+        assert wal.record_count() == 0
+        for i in range(4):
+            wal.append(WalRecord(op="insert", ids=np.array([i], np.int64),
+                                 vectors=_rows(rng, 1)))
+        wal.seal()
+        assert wal.record_count() == 4  # sealing moves bytes, not records
+        wal.close()
+        wal2, _ = WriteAheadLog.open(path)
+        assert wal2.record_count() == 4  # recovered count survives reopen
+        wal2.append(WalRecord(op="delete", ids=np.array([0], np.int64)))
+        assert wal2.record_count() == 5
+        wal2.close()
+
+
 # -- basic mutability semantics ---------------------------------------------
 
 

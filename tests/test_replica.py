@@ -1,0 +1,560 @@
+"""raft_tpu.replica — replicated serving (ISSUE 13 acceptance, CPU).
+
+The router's admission filters (breaker, staleness floor, exclusion,
+least-depth tie-break), replica-group failover that re-queues instead
+of erroring (a replica killed at the ``replica.dispatch`` seam is
+invisible to callers except as latency), gate-parity (a one-replica
+group is bit-identical to a bare engine), WAL shipping (seal →
+``wal.ship`` → CRC-verified ``replica.apply`` replay; a torn tail in a
+shipped chunk is rejected at the clean-prefix offset and re-requested,
+never partially applied), follower restart resume, generation follow
+across compaction, and the bounded-staleness admission floor.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.bench.loadgen import run_open_loop
+from raft_tpu.mutable import MutableIndex, compact
+from raft_tpu.neighbors import brute_force
+from raft_tpu.replica import (
+    Follower,
+    ReplicaGroup,
+    Replication,
+    Router,
+    Shipper,
+    ShipRejected,
+)
+from raft_tpu.replica.shipping import _read_file_chunk
+from raft_tpu.robust import faults
+from raft_tpu.robust.retry import CircuitBreaker
+from raft_tpu.serve import DeadlineExceeded, QueueFull, ServingEngine
+
+
+@pytest.fixture(autouse=True)
+def _pristine_gates():
+    faults.disable()
+    faults.clear()
+    obs.disable()
+    obs.registry().reset()
+    yield
+    faults.disable()
+    faults.clear()
+    obs.disable()
+    obs.registry().reset()
+
+
+@pytest.fixture
+def replica_obs():
+    reg = obs.registry()
+    reg.reset()
+    obs.enable()
+    yield reg
+    obs.disable()
+    reg.reset()
+
+
+def _data(rng, n, d, nc=8, scale=0.25):
+    c = rng.standard_normal((nc, d)).astype(np.float32)
+    return (c[rng.integers(0, nc, n)] + scale * rng.standard_normal((n, d))).astype(
+        np.float32
+    )
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(13)
+    return _data(rng, 256, 16), _data(rng, 64, 16)
+
+
+@pytest.fixture(scope="module")
+def bf_index(corpus):
+    X, _ = corpus
+    return brute_force.build(X)
+
+
+class VClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+
+class TestRouter:
+    def test_least_depth_wins_lowest_id_breaks_ties(self):
+        r = Router(3)
+        assert r.pick([5, 2, 9]) == 1
+        assert r.pick([4, 4, 4]) == 0  # tie -> lowest id, deterministic
+
+    def test_exclusion_skips_the_failed_replica(self):
+        r = Router(2)
+        assert r.pick([0, 10], exclude={0}) == 1
+        assert r.pick([0, 10], exclude={0, 1}) is None
+
+    def test_open_breaker_quarantines_the_replica(self):
+        clk = VClock()
+        r = Router(2, failure_threshold=1, reset_timeout_s=1.0, clock=clk)
+        r.breaker(1).record_failure()
+        assert r.breaker(1).state == CircuitBreaker.OPEN
+        assert r.pick([10, 0]) == 0  # deeper but the only healthy one
+        r.breaker(0).record_failure()
+        assert r.pick([0, 0]) is None  # everything open -> no admission
+
+    def test_half_open_takes_no_new_admissions(self):
+        clk = VClock()
+        r = Router(1, failure_threshold=1, reset_timeout_s=0.5, clock=clk)
+        r.breaker(0).record_failure()
+        clk.advance(1.0)
+        assert r.breaker(0).allow()  # the pump's probe
+        assert r.breaker(0).state == CircuitBreaker.HALF_OPEN
+        assert r.pick([0]) is None  # callers wait for the probe verdict
+
+    def test_staleness_floor_excludes_lagging_replicas(self):
+        r = Router(2, max_staleness_records=5)
+        r.set_staleness(1, 10)
+        assert not r.admissible(1)
+        assert r.pick([99, 0]) == 0  # the fresh replica wins despite depth
+        r.set_staleness(1, 5)  # exactly at the bound is admissible
+        assert r.pick([99, 0]) == 1
+        assert Router(2).admissible(1)  # no floor configured -> no filter
+
+
+# ---------------------------------------------------------------------------
+# ReplicaGroup: routing, parity, health
+# ---------------------------------------------------------------------------
+
+
+class TestGroup:
+    def test_one_replica_group_is_bit_identical_to_bare_engine(self, corpus, bf_index):
+        """Gates off, one replica: the group adds zero numeric surface."""
+        _, Q = corpus
+        eng = ServingEngine()
+        eng.register("t", "brute_force", bf_index)
+        f1 = eng.submit("t", Q[:8], 5)
+        eng.run_until_idle()
+        grp = ReplicaGroup(n_replicas=1)
+        grp.register("t", "brute_force", bf_index)
+        f2 = grp.submit("t", Q[:8], 5)
+        grp.run_until_idle()
+        r1, r2 = f1.result(0), f2.result(0)
+        assert np.array_equal(r1.distances, r2.distances)
+        assert np.array_equal(r1.indices, r2.indices)
+        assert (r1.coverage, r1.degraded, r1.generation) == (
+            r2.coverage, r2.degraded, r2.generation)
+
+    def test_submission_spreads_by_queue_depth(self, corpus, bf_index):
+        _, Q = corpus
+        grp = ReplicaGroup(n_replicas=2)
+        grp.register("t", "brute_force", bf_index)
+        grp.submit("t", Q[:4], 5)
+        grp.submit("t", Q[:4], 5)
+        depths = [eng.queue_depth() for eng in grp.engines]
+        assert depths == [4, 4]  # second submit routed to the empty replica
+        grp.run_until_idle()
+
+    def test_queue_full_falls_through_then_surfaces_typed(self, corpus, bf_index):
+        """A full replica queue spills to the next; only when EVERY
+        admissible replica rejects does the caller see QueueFull."""
+        _, Q = corpus
+        grp = ReplicaGroup(
+            engine_factory=lambda r: ServingEngine(max_batch=4, queue_capacity=4),
+            n_replicas=2,
+        )
+        grp.register("t", "brute_force", bf_index)
+        grp.submit("t", Q[:4], 5)   # fills replica 0
+        grp.submit("t", Q[:4], 5)   # spills to replica 1
+        with pytest.raises(QueueFull):
+            grp.submit("t", Q[:4], 5)
+        grp.run_until_idle()
+
+    def test_health_reports_per_replica_state(self, corpus, bf_index):
+        _, Q = corpus
+        grp = ReplicaGroup(n_replicas=2, name="pair")
+        grp.register("t", "brute_force", bf_index)
+        grp.submit("t", Q[:4], 5)
+        h = grp.health()
+        assert h["name"] == "pair" and len(h["replicas"]) == 2
+        assert h["in_flight"] == 1 and h["parked"] == 0
+        assert {r["breaker"] for r in h["replicas"]} == {"closed"}
+        assert sum(r["queue_rows"] for r in h["replicas"]) == 4
+        assert "queue" in h["replicas"][0]["engine"]
+        grp.run_until_idle()
+
+
+# ---------------------------------------------------------------------------
+# Failover
+# ---------------------------------------------------------------------------
+
+
+class TestFailover:
+    def test_replica_kill_invisible_to_callers(self, corpus, bf_index, replica_obs):
+        """Kill replica 1 at the replica.dispatch seam for the whole
+        run: every caller future still completes with a full-coverage
+        result; the death shows up only in serve.failovers and the
+        breaker state."""
+        _, Q = corpus
+        faults.enable()
+        faults.install("replica.dispatch", error=RuntimeError("chaos kill"),
+                       match={"replica": 1})
+        grp = ReplicaGroup(n_replicas=2, failure_threshold=2, reset_timeout_s=30.0)
+        grp.register("t", "brute_force", bf_index)
+        futs = [grp.submit("t", Q[i % len(Q)][None, :], 5) for i in range(32)]
+        grp.run_until_idle()
+        results = [f.result(0) for f in futs]  # raises if any caller saw the kill
+        assert len(results) == 32
+        assert all(r.coverage == 1.0 and not r.degraded for r in results)
+        assert grp.router.breaker(1).state == CircuitBreaker.OPEN
+        assert replica_obs.counter(
+            "serve.failovers", index_id="t", replica="1"
+        ).value >= 1
+        assert replica_obs.counter(
+            "replica.pump_failures", replica="1", kind="RuntimeError"
+        ).value >= 2
+
+    def test_failover_keeps_the_request_trace(self, corpus, bf_index, replica_obs):
+        """The re-submitted request keeps its trace ID and the timeline
+        records a replica.failover span under it."""
+        _, Q = corpus
+        faults.enable()
+        faults.install("replica.dispatch", error=RuntimeError("one kill"),
+                       match={"replica": 0}, trigger="first_n", first_n=1)
+        grp = ReplicaGroup(n_replicas=2, failure_threshold=1, reset_timeout_s=30.0)
+        grp.register("t", "brute_force", bf_index)
+        fut = grp.submit("t", Q[:1], 5)
+        grp.run_until_idle()
+        res = fut.result(0)
+        assert res.trace_id
+        spans = replica_obs.spans("replica.failover")
+        assert spans and res.trace_id in spans[0]["trace"]
+        assert spans[0]["args"]["from_replica"] == 0
+
+    def test_killed_replica_recovers_through_half_open_probe(self, corpus, bf_index):
+        """A transient fault window trips the breaker; after the reset
+        timeout the pump's probe succeeds and the replica serves again."""
+        _, Q = corpus
+        faults.enable()
+        faults.install("replica.dispatch", error=RuntimeError("transient"),
+                       match={"replica": 1}, trigger="first_n", first_n=2)
+        grp = ReplicaGroup(n_replicas=2, failure_threshold=2, reset_timeout_s=0.01)
+        grp.register("t", "brute_force", bf_index)
+        futs = [grp.submit("t", Q[i:i + 1], 5) for i in range(4)]
+        grp.run_until_idle()
+        assert all(f.result(0).coverage == 1.0 for f in futs)
+        assert grp.router.breaker(1).state == CircuitBreaker.OPEN
+        time.sleep(0.02)
+        for _ in range(3):  # probe (half-open), close, settle
+            grp.step(force=True)
+        assert grp.router.breaker(1).state == CircuitBreaker.CLOSED
+        fut = grp.submit("t", Q[:1], 5)
+        grp.run_until_idle()
+        assert fut.result(0).coverage == 1.0
+
+    def test_total_outage_parks_work_instead_of_erroring(self, corpus, bf_index):
+        """Every replica down: in-flight work parks (no errors, no
+        drops) and completes once any replica comes back."""
+        _, Q = corpus
+        faults.enable()
+        spec = faults.install("replica.dispatch", error=RuntimeError("outage"))
+        grp = ReplicaGroup(n_replicas=2, failure_threshold=1, reset_timeout_s=0.01)
+        grp.register("t", "brute_force", bf_index)
+        futs = [grp.submit("t", Q[i:i + 1], 5) for i in range(4)]
+        for _ in range(6):
+            grp.step(force=True)
+        assert not any(f.done() for f in futs)  # parked, not failed
+        assert grp.health()["parked"] == 4
+        faults.remove(spec)  # the outage ends
+        time.sleep(0.02)  # let the breakers' reset window pass
+        grp.run_until_idle()
+        assert all(f.result(0).coverage == 1.0 for f in futs)
+
+    def test_deadline_expiry_during_failover_is_typed(self, corpus, bf_index):
+        _, Q = corpus
+        faults.enable()
+        faults.install("replica.dispatch", error=RuntimeError("outage"))
+        grp = ReplicaGroup(n_replicas=2, failure_threshold=1, reset_timeout_s=5.0)
+        grp.register("t", "brute_force", bf_index)
+        fut = grp.submit("t", Q[:1], 5, deadline_ms=1.0)
+        deadline = time.monotonic() + 5.0
+        while not fut.done() and time.monotonic() < deadline:
+            grp.step(force=True)
+            time.sleep(0.001)
+        assert isinstance(fut.exception(0), DeadlineExceeded)
+
+    def test_open_loop_chaos_drill_accounts_for_every_request(
+        self, corpus, bf_index, replica_obs
+    ):
+        """The ISSUE acceptance drill: open-loop load with replica 1
+        killed MID-RUN (at the replica.dispatch seam, while it holds
+        queued work) — zero caller-visible errors, the LoadReport
+        accounts for every request, failovers counted."""
+        _, Q = corpus
+        faults.enable()
+        grp = ReplicaGroup(n_replicas=2, failure_threshold=2, reset_timeout_s=30.0)
+        grp.register("t", "brute_force", bf_index)
+
+        class KillMidRun:
+            """Engine shim: permanently kill replica 1 the first time it
+            is seen holding queued work after warm-up — the kill lands
+            with requests in flight, the worst case for failover."""
+
+            def __init__(self, grp):
+                self.grp, self.submitted, self.killed = grp, 0, False
+
+            def submit(self, *a, **kw):
+                fut = self.grp.submit(*a, **kw)
+                self.submitted += 1
+                if (not self.killed and self.submitted >= 8
+                        and self.grp.engines[1].queue_depth() > 0):
+                    self.killed = True
+                    faults.install(
+                        "replica.dispatch", error=RuntimeError("chaos kill"),
+                        match={"replica": 1},
+                    )
+                return fut
+
+            def step(self, *a, **kw):
+                return self.grp.step(*a, **kw)
+
+            def run_until_idle(self, *a, **kw):
+                return self.grp.run_until_idle(*a, **kw)
+
+        shim = KillMidRun(grp)
+        report, _ = run_open_loop(
+            shim, "t", Q, 5, rate_qps=3000.0, n_requests=64, seed=11,
+        )
+        assert shim.killed  # the drill actually drilled
+        assert report.completed == 64
+        assert report.rejected == {}
+        assert report.completed + sum(report.rejected.values()) == report.n_requests
+        assert replica_obs.counter(
+            "serve.failovers", index_id="t", replica="1"
+        ).value >= 1
+
+
+# ---------------------------------------------------------------------------
+# WAL shipping: seal -> ship -> replay
+# ---------------------------------------------------------------------------
+
+
+def _mk_leader(tmp_path, X, n=96):
+    leader = MutableIndex.open(str(tmp_path / "leader"), "brute_force", X.shape[1])
+    leader.insert(X[:n])
+    return leader
+
+
+def _mk_follower(tmp_path, dim, name="f0"):
+    return Follower(
+        str(tmp_path / "leader"), str(tmp_path / name),
+        algo="brute_force", dim=dim, name=name,
+    )
+
+
+def _same_results(a, b, Q, k=5):
+    da, ia = a.snapshot().search(Q, k)
+    db, ib = b.snapshot().search(Q, k)
+    return np.array_equal(np.asarray(ia), np.asarray(ib)) and np.array_equal(
+        np.asarray(da), np.asarray(db)
+    )
+
+
+class TestShipping:
+    def test_follower_serves_bit_identical_at_same_generation(self, corpus, tmp_path):
+        X, Q = corpus
+        leader = _mk_leader(tmp_path, X)
+        fol = _mk_follower(tmp_path, X.shape[1])
+        rep = Replication(leader, [fol], seal_bytes=1)
+        rep.tick()
+        assert fol.index.generation == leader.generation
+        assert rep.staleness(0) == 0
+        assert _same_results(leader, fol, Q)
+        # incremental: more mutations ship on the next tick
+        leader.insert(X[96:128])
+        leader.delete(np.arange(10))
+        assert rep.staleness(0) > 0  # lag exists until sealed + shipped
+        rep.tick()
+        assert rep.staleness(0) == 0
+        assert _same_results(leader, fol, Q)
+
+    def test_torn_tail_in_shipped_chunk_rejected_and_rerequested(
+        self, corpus, tmp_path, replica_obs
+    ):
+        """Transport damage (a flipped byte = torn/corrupt frame) makes
+        the follower raise ShipRejected at its clean-prefix offset —
+        never applying a partial record — and the shipper re-requests
+        exactly from there; the retry converges to bit-identical."""
+        X, Q = corpus
+        leader = _mk_leader(tmp_path, X)
+        leader.wal.seal()
+        fol = _mk_follower(tmp_path, X.shape[1])
+        calls = {"n": 0}
+
+        def flaky(path, offset, nbytes):
+            calls["n"] += 1
+            data = _read_file_chunk(path, offset, nbytes)
+            if calls["n"] == 1:
+                broken = bytearray(data)
+                broken[-1] ^= 0xFF
+                return bytes(broken)
+            return data
+
+        sh = Shipper(leader.wal, fol, transport=flaky)
+        assert sh.ship() > 0
+        assert calls["n"] >= 2  # the damaged range was re-requested
+        assert replica_obs.counter(
+            "replica.ship.rejected", follower="f0", reason="crc"
+        ).value == 1
+        assert _same_results(leader, fol, Q)
+
+    def test_persistent_corruption_surfaces_after_retries(self, corpus, tmp_path):
+        X, _ = corpus
+        leader = _mk_leader(tmp_path, X)
+        leader.wal.seal()
+        fol = _mk_follower(tmp_path, X.shape[1])
+
+        def always_broken(path, offset, nbytes):
+            data = bytearray(_read_file_chunk(path, offset, nbytes))
+            data[-1] ^= 0xFF
+            return bytes(data)
+
+        sh = Shipper(leader.wal, fol, transport=always_broken, max_retries=2)
+        with pytest.raises(ShipRejected):
+            sh.ship()
+        assert fol.position.applied_records == 0  # nothing partial applied
+
+    def test_follower_restart_resumes_from_persisted_position(self, corpus, tmp_path):
+        X, Q = corpus
+        leader = _mk_leader(tmp_path, X)
+        fol = _mk_follower(tmp_path, X.shape[1])
+        rep = Replication(leader, [fol], seal_bytes=1)
+        rep.tick()
+        pos = fol.position
+        # kill and restart: the new follower recovers from its own
+        # directory (shipped frames + FOLLOWER.json), bit-identical
+        fol2 = _mk_follower(tmp_path, X.shape[1])
+        assert fol2.position == pos
+        assert _same_results(fol.index, fol2.index, Q)
+        # and resumes shipping incrementally, not from scratch
+        leader.insert(X[128:160])
+        rep2 = Replication(leader, [fol2], seal_bytes=1)
+        rep2.tick()
+        assert fol2.position.applied_records == pos.applied_records + 1
+        assert _same_results(leader, fol2, Q)
+
+    def test_follower_follows_compaction_generation_flips(
+        self, corpus, tmp_path, replica_obs
+    ):
+        X, Q = corpus
+        leader = _mk_leader(tmp_path, X)
+        fol = _mk_follower(tmp_path, X.shape[1])
+        rep = Replication(leader, [fol], seal_bytes=1)
+        rep.tick()
+        gen0 = fol.index.generation
+        compact(leader)  # new generation, fresh WAL
+        leader.insert(X[128:160])
+        rep.tick()
+        assert fol.index.generation == leader.generation > gen0
+        assert rep.staleness(0) == 0
+        assert _same_results(leader, fol, Q)
+        assert replica_obs.counter(
+            "replica.generation_syncs", follower="f0"
+        ).value >= 2
+
+    def test_ship_and_apply_seams_fail_safe(self, corpus, tmp_path, replica_obs):
+        """A fault at wal.ship or replica.apply costs one tick — counted,
+        never raised into the serving loop — and the next clean tick
+        catches up."""
+        X, Q = corpus
+        leader = _mk_leader(tmp_path, X)
+        fol = _mk_follower(tmp_path, X.shape[1])
+        rep = Replication(leader, [fol], seal_bytes=1)
+        with faults.injected("wal.ship", error=OSError("link down")):
+            rep.tick()  # must not raise
+        assert fol.position.applied_records == 0
+        assert replica_obs.counter(
+            "replica.ship.errors", follower="f0", kind="OSError"
+        ).value == 1
+        with faults.injected("replica.apply", error=OSError("apply refused")):
+            rep.tick()
+        assert fol.position.applied_records == 0
+        rep.tick()  # the outage ends; catch-up is complete
+        assert rep.staleness(0) == 0
+        assert _same_results(leader, fol, Q)
+
+    def test_staleness_floor_gates_follower_admission(self, corpus, tmp_path):
+        """A follower behind the bound takes no reads; once sealed and
+        shipped it re-enters rotation."""
+        X, Q = corpus
+        leader = _mk_leader(tmp_path, X)
+        fol = _mk_follower(tmp_path, X.shape[1])
+        rep = Replication(leader, [fol], seal_bytes=1 << 30)  # never auto-seals
+        grp = ReplicaGroup(n_replicas=2, max_staleness_records=0)
+        grp.register_mutable_replicated("m", rep)
+        grp.maintenance_tick()
+        assert grp.router.staleness(1) > 0
+        assert not grp.router.admissible(1)
+        fut = grp.submit("m", Q[:2], 5)  # must route to the leader
+        grp.run_until_idle()
+        assert fut.result(0).coverage == 1.0
+        leader.wal.seal()
+        grp.maintenance_tick()
+        assert grp.router.staleness(1) == 0
+        assert grp.router.admissible(1)
+
+    def test_replicated_group_serves_through_leader_and_follower(
+        self, corpus, tmp_path
+    ):
+        """End to end: a 2-replica mutable registration where reads land
+        on both the leader and the synced follower and agree."""
+        X, Q = corpus
+        leader = _mk_leader(tmp_path, X)
+        rep = Replication(
+            leader, [_mk_follower(tmp_path, X.shape[1])], seal_bytes=1
+        )
+        grp = ReplicaGroup(n_replicas=2, max_staleness_records=0)
+        grp.register_mutable_replicated("m", rep)
+        grp.maintenance_tick()
+        futs = [grp.submit("m", Q[i:i + 2], 5) for i in range(8)]
+        grp.run_until_idle()
+        results = [f.result(0) for f in futs]
+        assert all(r.generation == leader.generation for r in results)
+        # both replicas took work (depth-spread admission)
+        assert {r.indices.shape for r in results} == {(2, 5)}
+        base = results[0]
+        again = grp.submit("m", Q[0:2], 5)
+        grp.run_until_idle()
+        assert np.array_equal(again.result(0).indices, base.indices)
+
+
+# ---------------------------------------------------------------------------
+# Threaded pump mode (what the bench's replicated phase uses)
+# ---------------------------------------------------------------------------
+
+
+class TestThreadedPumps:
+    def test_threaded_group_serves_and_survives_a_kill(self, corpus, bf_index):
+        _, Q = corpus
+        faults.enable()
+        faults.install("replica.dispatch", error=RuntimeError("chaos kill"),
+                       match={"replica": 1})
+        grp = ReplicaGroup(n_replicas=2, failure_threshold=2, reset_timeout_s=30.0)
+        grp.register("t", "brute_force", bf_index)
+        grp.start()
+        try:
+            futs = [grp.submit("t", Q[i:i + 1], 5) for i in range(16)]
+            results = [f.result(timeout=30.0) for f in futs]
+            assert all(r.coverage == 1.0 for r in results)
+        finally:
+            grp.stop()
+        assert grp.health()["threaded"] is False

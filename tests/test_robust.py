@@ -26,6 +26,7 @@ from raft_tpu.core.errors import (
 from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
 from raft_tpu.parallel import bootstrap, make_mesh
 from raft_tpu.robust import (
+    CircuitBreaker,
     RetryError,
     RetryPolicy,
     faults,
@@ -285,6 +286,88 @@ class TestRetry:
             return "ok"
 
         assert once_flaky() == "ok"
+
+
+# -- circuit breaker --------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestCircuitBreaker:
+    def test_trips_on_consecutive_failures_only(self):
+        clk = _Clock()
+        b = CircuitBreaker("r0", failure_threshold=3, clock=clk)
+        for _ in range(2):
+            b.record_failure()
+        b.record_success()  # a success resets the consecutive count
+        assert b.state == CircuitBreaker.CLOSED and b.failures == 0
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == CircuitBreaker.OPEN
+        assert not b.allow()  # quarantined until the reset window passes
+
+    def test_half_open_probe_admits_exactly_one(self):
+        clk = _Clock()
+        b = CircuitBreaker("r0", failure_threshold=1, reset_timeout_s=2.0, clock=clk)
+        b.record_failure()
+        clk.advance(1.9)
+        assert not b.allow()  # reset window not yet elapsed
+        clk.advance(0.2)
+        assert b.allow()  # the single probe
+        assert b.state == CircuitBreaker.HALF_OPEN
+        assert not b.allow()  # no second caller while the probe is out
+
+    def test_probe_success_closes(self):
+        clk = _Clock()
+        b = CircuitBreaker("r0", failure_threshold=1, reset_timeout_s=1.0, clock=clk)
+        b.record_failure()
+        clk.advance(1.1)
+        assert b.allow()
+        b.record_success()
+        assert b.state == CircuitBreaker.CLOSED and b.failures == 0
+        assert b.allow()
+
+    def test_probe_failure_reopens_and_pushes_the_horizon(self):
+        clk = _Clock()
+        b = CircuitBreaker("r0", failure_threshold=1, reset_timeout_s=1.0, clock=clk)
+        b.record_failure()
+        clk.advance(1.1)
+        assert b.allow()
+        b.record_failure()  # probe failed
+        assert b.state == CircuitBreaker.OPEN
+        clk.advance(0.5)
+        assert not b.allow()  # the horizon restarted at the probe failure
+        clk.advance(0.6)
+        assert b.allow()
+
+    def test_state_gauge_and_transition_counter(self, chaos_obs):
+        clk = _Clock()
+        b = CircuitBreaker("r7", failure_threshold=1, reset_timeout_s=1.0, clock=clk)
+
+        def gauge():
+            return chaos_obs.gauge("robust.breaker.state", target="r7").value
+
+        assert gauge() == 0.0  # closed
+        b.record_failure()
+        assert gauge() == 2.0  # open
+        clk.advance(1.1)
+        b.allow()
+        assert gauge() == 1.0  # half_open
+        b.record_success()
+        assert gauge() == 0.0
+        snap = chaos_obs.as_dict()["counters"]
+        assert snap['robust.breaker.transitions{target="r7",to="open"}'] == 1.0
+        assert snap['robust.breaker.transitions{target="r7",to="half_open"}'] == 1.0
+        assert snap['robust.breaker.transitions{target="r7",to="closed"}'] == 1.0
 
 
 # -- bootstrap retry --------------------------------------------------------
