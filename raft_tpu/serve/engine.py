@@ -69,11 +69,21 @@ _DEFAULT_MODES = {
     "sharded_ivf_pq_lists": "sharded",
     # pre-built TieredIndex: device scan + host-tier refine gather
     "tiered": "auto",
+    # pre-built (or auto-degraded) TieredShardedIndex: per-shard HBM
+    # codes behind the ring merge, per-shard host tiers for the re-rank
+    "tiered_sharded": "sharded",
 }
 
 #: algos the HBM placement planner knows how to model (and whose refine
 #: dataset can degrade to the host tier)
 _TIERABLE_ALGOS = ("ivf_pq", "ivf_flat", "brute_force")
+
+#: sharded algos whose refine dataset can degrade to per-shard host
+#: tiers (the registration converts to algo="tiered_sharded")
+_SHARDED_TIERABLE = {
+    "sharded_ivf_flat": ("ivf_flat", "ivf_flat"),
+    "sharded_ivf_pq_lists": ("ivf_pq", "ivf_pq_lists"),
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,15 +155,24 @@ class ServingEngine:
         slow_shard_s: Optional[float] = 0.25,
         maintenance_interval_ms: float = 10.0,
         hbm_budget_bytes: Optional[int] = None,
+        host_budget_bytes: Optional[int] = None,
     ):
         self.max_batch = int(max_batch)
         #: device-HBM budget for the placement planner (None = unplanned:
         #: every registration keeps its dataset wherever the caller put it)
         self.hbm_budget_bytes = hbm_budget_bytes
+        #: per-shard host-RAM budget for the sharded three-level planner
+        #: (None = unconstrained: spilled slabs stay in host RAM, never
+        #: planned to disk)
+        self.host_budget_bytes = host_budget_bytes
         self._residencies: Dict[str, object] = {}
         #: the planner's last verdict (an hbm_model.Placement), for
         #: introspection/tests after registrations
         self.placement = None
+        #: per-registration sharded verdicts (hbm_model.ShardedPlacement),
+        #: keyed by index_id — sharded registrations plan per shard and
+        #: do not join the single-device fleet plan above
+        self.sharded_placements: Dict[str, object] = {}
         self.batcher = MicroBatcher(
             max_batch=max_batch,
             max_wait_ms=max_wait_ms,
@@ -209,12 +228,30 @@ class ServingEngine:
         the already-registered indexes is transparently rewrapped in a
         :class:`~raft_tpu.tiered.HostVectorStore` — registration degrades
         to tiered serving instead of OOMing at first dispatch.
+
+        ``algo="tiered_sharded"`` registers a pre-built
+        :class:`raft_tpu.tiered.TieredShardedIndex` (``mesh``/``axis``
+        default to the index's own). A *sharded* registration with a
+        ``dataset`` and the budget set runs the per-shard three-level
+        planner instead: a refine slab that cannot stay device-resident
+        per shard converts the registration to ``tiered_sharded`` over
+        per-shard :class:`~raft_tpu.tiered.ShardedHostTier` stores —
+        ring-merged winners re-rank from each shard's host.
         """
         expects(algo in _DEFAULT_MODES, "unknown serving algo %r (want one of %s)",
                 algo, ", ".join(sorted(_DEFAULT_MODES)))
-        if algo.startswith("sharded_"):
+        if algo == "tiered_sharded" and mesh is None:
+            mesh = index.mesh
+            axis = index.axis
+        if algo.startswith("sharded_") or algo == "tiered_sharded":
             expects(mesh is not None, "sharded algo %r needs mesh=", algo)
-        dataset = self._plan_tier(index_id, algo, index, dataset)
+        if algo in _SHARDED_TIERABLE:
+            algo, index, dataset = self._plan_tier_sharded(
+                index_id, algo, index, dataset, mesh=mesh, axis=axis,
+                merge_mode=merge_mode, params=params, search_kwargs=search_kwargs,
+            )
+        else:
+            dataset = self._plan_tier(index_id, algo, index, dataset)
         self._indexes[index_id] = _Registration(
             index_id=index_id,
             algo=algo,
@@ -267,6 +304,75 @@ class ServingEngine:
             dataset = HostVectorStore(np.asarray(dataset))
             obs.inc("serve.tiered_degrades", index_id=index_id, algo=algo)
         return dataset
+
+    def _plan_tier_sharded(
+        self, index_id: str, algo: str, index, dataset, *,
+        mesh, axis, merge_mode, params, search_kwargs,
+    ):
+        """Per-shard three-level placement for a lists-sharded
+        registration. Returns the (possibly converted) ``(algo, index,
+        dataset)`` triple.
+
+        With no budget or no refine dataset (or a caller-prepared host
+        store) the registration passes through untouched. Otherwise the
+        index's measured residency runs through
+        :func:`~raft_tpu.ops.pallas.hbm_model.plan_placement_sharded`:
+        required components must fit each shard's device cap — an
+        infeasible plan is a typed registration error — and a spilled
+        refine slab converts the registration to a
+        :class:`~raft_tpu.tiered.TieredShardedIndex` whose per-shard
+        :class:`~raft_tpu.tiered.ShardedHostTier` follows the lists-
+        sharded row ownership, so each candidate re-ranks from the host
+        of the shard that scanned it."""
+        if self.hbm_budget_bytes is None or dataset is None:
+            return algo, index, dataset
+        from raft_tpu.neighbors.refine import is_host_dataset
+
+        if is_host_dataset(dataset):
+            return algo, index, dataset
+        from raft_tpu.ops.pallas.hbm_model import (
+            plan_placement_sharded,
+            residency_for_index,
+        )
+
+        res_algo, scan_algo = _SHARDED_TIERABLE[algo]
+        n_shards = mesh.shape[axis]
+        res = residency_for_index(
+            index_id, res_algo, index, refine_rows=int(np.shape(dataset)[0])
+        )
+        placement = plan_placement_sharded(
+            [res], n_shards,
+            hbm_budget_per_shard=self.hbm_budget_bytes,
+            host_budget_per_shard=self.host_budget_bytes,
+        )
+        expects(
+            placement.feasible,
+            "registering %r needs %d B/shard of scan-resident HBM over %d "
+            "shards against a per-shard budget of %d B — required components "
+            "cannot tier to the host; add shards or shrink the index",
+            index_id, placement.device_bytes_per_shard - placement.staging_device_bytes,
+            n_shards, self.hbm_budget_bytes,
+        )
+        self.sharded_placements[index_id] = placement
+        if placement.tier(index_id, "raw_vectors") == "device":
+            return algo, index, dataset
+        from raft_tpu.tiered import ShardedHostTier, TieredShardedIndex
+
+        tier_kw = {
+            key: search_kwargs.pop(key)
+            for key in ("refine_ratio", "micro_batch", "metric_arg")
+            if key in search_kwargs
+        }
+        tier = ShardedHostTier.from_lists(
+            index, np.asarray(dataset), n_shards,
+            fetch_depth_rows=search_kwargs.pop("fetch_depth_rows", None),
+        )
+        tiered = TieredShardedIndex(
+            mesh, scan_algo, index, tier, axis=axis,
+            search_params=params, merge_mode=merge_mode, **tier_kw,
+        )
+        obs.inc("serve.tiered_degrades", index_id=index_id, algo=algo)
+        return "tiered_sharded", tiered, None
 
     def register_mutable(
         self,
@@ -639,6 +745,19 @@ class ServingEngine:
             return lambda q: cagra.search(
                 reg.index, q, k, reg.params, query_batch=bucket, mode=reg.mode, **kw
             )
+        if reg.algo == "tiered_sharded":
+            # the composition path: timed health probe feeds the scan-side
+            # mask, tier-side failures are detected in-line by the gather;
+            # the index returns a DegradedResult with combined coverage
+            def tiered_sharded_prog(q):
+                health = self._probe_health_timed(reg)
+                return reg.index.search(
+                    q, k, health=health, min_coverage=reg.min_coverage,
+                    merge_mode=None if reg.merge_mode == "auto" else reg.merge_mode,
+                    **kw,
+                )
+
+            return tiered_sharded_prog
         # sharded paths ride the degraded-search machinery: per-dispatch
         # timed health probe, failed/slow shards excluded, coverage out
         from raft_tpu.robust.degrade import sharded_search_degraded

@@ -8,17 +8,26 @@ mapped straight out of a v4 snapshot file — and are fetched per batch
 as a top-candidates gather, overlapped with the next micro-batch's scan.
 
 * :class:`HostVectorStore` — the host tier: double-buffered staging
-  gather (``np.take`` → ``device_put`` slab), ``host.fetch`` fault seam,
-  seeded-backoff retry, ``tiered.fetch.*`` metrics, optional mmap.
+  gather (``np.take`` → ``device_put`` slab) with duplicate-id
+  coalescing, madvise read-ahead hints and a fetch-depth budget on the
+  mmap/SSD path, ``host.fetch`` fault seam, seeded-backoff retry,
+  ``tiered.fetch.*`` metrics.
 * :class:`TieredIndex` — wraps an ivf_pq / ivf_flat / brute_force index
   with the scan → fetch → re-rank pipeline; results are bit-identical
   to the all-in-HBM ``search(dataset=...)`` path.
-* :func:`raft_tpu.ops.pallas.hbm_model.plan_placement` decides which
-  components spill to this tier; :class:`raft_tpu.serve.ServingEngine`
-  consults it at ``register()`` so oversubscribing HBM degrades to
-  tiered serving instead of OOMing.
+* :class:`ShardedHostTier` / :class:`TieredShardedIndex` — the pod-scale
+  composition: per-shard HBM-resident codes scanned under the ICI
+  ring/gather merge, ring-merged winners re-ranked from per-shard host
+  tiers, bit-identical to the resident sharded path; a dead host's tier
+  degrades coverage instead of hanging the ring.
+* :func:`raft_tpu.ops.pallas.hbm_model.plan_placement` (and its
+  per-shard three-level sibling ``plan_placement_sharded``) decides
+  which components spill to this tier; :class:`raft_tpu.serve.
+  ServingEngine` consults it at ``register()`` so oversubscribing HBM
+  degrades to tiered serving instead of OOMing.
 """
 from raft_tpu.tiered.store import HostVectorStore
 from raft_tpu.tiered.index import TieredIndex
+from raft_tpu.tiered.sharded import ShardedHostTier, TieredShardedIndex
 
-__all__ = ["HostVectorStore", "TieredIndex"]
+__all__ = ["HostVectorStore", "TieredIndex", "ShardedHostTier", "TieredShardedIndex"]

@@ -185,33 +185,59 @@ class TieredIndex:
 
             # Overlapped pipeline: scan i+1 is in flight while batch i's
             # rows stream up from the host tier.
-            outs = [None] * len(spans)
-            fetch_s = [0.0] * len(spans)
-            hidden = [False] * len(spans)
-            scan_next = self._scan(queries[spans[0][0]:spans[0][1]], kk, mode, **kwargs)
-            for i, (s, e) in enumerate(spans):
-                scan_cur = scan_next
-                if i + 1 < len(spans):
-                    ns, ne = spans[i + 1]
-                    scan_next = self._scan(queries[ns:ne], kk, mode, **kwargs)
-                # the pipeline's one forced sync: batch i's candidate ids
-                cand_np = np.asarray(scan_cur[1])
+            def consume(i, cand_np):
+                s, e = spans[i]
                 t0 = time.perf_counter()
                 slab = self.store.gather(cand_np)
-                fetch_s[i] = time.perf_counter() - t0
-                outs[i] = self._refine(slab, queries[s:e], cand_np, k)
-                if i + 1 < len(spans):
-                    # if the next scan is still running after the fetch, the
-                    # fetch cost the pipeline nothing — probe without blocking
-                    hidden[i] = not _is_ready(scan_next[1])
+                dt = time.perf_counter() - t0
+                return self._refine(slab, queries[s:e], cand_np, k), dt
+
+            outs, eff = run_overlapped(
+                len(spans),
+                lambda i: self._scan(
+                    queries[spans[i][0]:spans[i][1]], kk, mode, **kwargs
+                ),
+                consume,
+            )
             if obs.is_enabled():
-                total = sum(fetch_s)
-                eff = (
-                    sum(f for f, h in zip(fetch_s, hidden) if h) / total
-                    if total > _OVERLAP_EPS_S else 0.0
-                )
                 obs.set_gauge("tiered.overlap_efficiency", eff)
             return _collect(outs)
+
+
+def run_overlapped(n_batches: int, scan, consume):
+    """The scan→fetch→re-rank overlap schedule, shared by
+    :class:`TieredIndex` and :class:`raft_tpu.tiered.sharded.TieredShardedIndex`.
+
+    ``scan(i)`` dispatches batch *i*'s device scan and returns
+    ``(values, ids)`` device arrays WITHOUT syncing; ``consume(i,
+    cand_np)`` gathers + re-ranks batch *i* from its synced candidate
+    ids and returns ``(out, fetch_seconds)``. The helper owns the
+    pipeline invariants: scan *i+1* dispatched before batch *i*'s sync,
+    one forced sync per batch (the candidate ids), and the non-blocking
+    "was the next scan still running?" probe that credits a fetch as
+    hidden. Returns ``(outs, efficiency)`` — the fraction of total fetch
+    wall time hidden behind a still-running next scan."""
+    outs = [None] * n_batches
+    fetch_s = [0.0] * n_batches
+    hidden = [False] * n_batches
+    scan_next = scan(0)
+    for i in range(n_batches):
+        scan_cur = scan_next
+        if i + 1 < n_batches:
+            scan_next = scan(i + 1)
+        # the pipeline's one forced sync: batch i's candidate ids
+        cand_np = np.asarray(scan_cur[1])
+        outs[i], fetch_s[i] = consume(i, cand_np)
+        if i + 1 < n_batches:
+            # if the next scan is still running after the fetch, the
+            # fetch cost the pipeline nothing — probe without blocking
+            hidden[i] = not _is_ready(scan_next[1])
+    total = sum(fetch_s)
+    eff = (
+        sum(f for f, h in zip(fetch_s, hidden) if h) / total
+        if total > _OVERLAP_EPS_S else 0.0
+    )
+    return outs, eff
 
 
 def _is_ready(arr) -> bool:

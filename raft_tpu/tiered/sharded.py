@@ -1,0 +1,408 @@
+"""Pod-scale tiered sharded search: per-shard HBM codes, per-host tiers.
+
+This is the composition of the repo's two scale mechanisms — the
+lists-sharded multichip scan with the ICI ring top-k
+(:mod:`raft_tpu.parallel.sharded_ann`) and out-of-core tiered serving
+(:mod:`raft_tpu.tiered.index`) — into the FusionANNS end-state: each
+shard's compressed codes stay HBM-resident, each shard's raw vectors
+live on *that shard's host* (RAM or SSD-backed mmap), and only the
+ring-merged global winners are re-ranked from the host tiers.
+
+Data path per micro-batch::
+
+    shard scan (per device) ──ring/gather merge──► global kk candidate ids
+                                                        │ (one forced sync)
+    per-shard host gather: owner[id] routes each id to its shard's
+    HostVectorStore; stores fetch their unique local rows once and
+    scatter into ONE [nq, kk, dim] slab
+                                                        │
+    _refine_gathered_impl(slab) ──► (distances, indices)[:k]
+
+The schedule is the shared :func:`raft_tpu.tiered.index.run_overlapped`
+pipeline: the host gather for batch *i* hides behind shard scan *i+1*,
+and the ``tiered.overlap_efficiency`` gauge reports the hidden fraction.
+
+Results are bit-identical to the resident sharded path (sharded scan for
+``k * refine_ratio`` + device-resident refine): the merge engines are
+already bit-identical to each other, the gather substitutes row 0 for
+invalid ids exactly like the device gather, and the re-rank is the same
+jit core.
+
+Failure semantics compose, too. A scan-side ``health`` mask demotes a
+shard inside the merge exactly as in :mod:`raft_tpu.robust.degrade`; a
+*tier*-side failure (a dead host: typed
+:class:`~raft_tpu.core.errors.HostFetchError` after retries from one
+shard's store) masks that shard's candidates to ``-1`` before the
+re-rank — the ring never stalls, healthy shards keep id-parity, and the
+returned :class:`~raft_tpu.robust.degrade.DegradedResult` carries the
+combined coverage. Each per-shard store fires the ``host.fetch`` fault
+seam with ``shard=s`` context, so chaos specs can kill one host's tier
+with ``match={"shard": s}``.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core.errors import HostFetchError, ShardFailure, expects
+from raft_tpu.neighbors.refine import _refine_gathered_impl
+from raft_tpu.ops.distance import resolve_metric
+from raft_tpu.tiered.index import _collect, run_overlapped
+from raft_tpu.tiered.store import HostVectorStore
+
+#: sharded scan families whose list layout carries global row ids
+ALGOS = ("ivf_flat", "ivf_pq_lists")
+
+
+class ShardedHostTier:
+    """Per-shard host vector tiers behind one global-id gather.
+
+    ``stores[s]`` holds the raw rows that shard ``s``'s device scans
+    (its slice of the inverted lists), indexed by *local* row position;
+    ``owner[global_id] -> shard`` and ``local[global_id] -> local row``
+    route a merged candidate id to the store that has it. The gather
+    fans candidate ids out by owner, reads each store once (dedup'd,
+    depth-budgeted, read-ahead-hinted — see
+    :meth:`HostVectorStore.gather_rows`), and scatters into one staging
+    slab shaped like the flat store's.
+    """
+
+    def __init__(
+        self,
+        stores: Sequence[HostVectorStore],
+        owner: np.ndarray,
+        local: np.ndarray,
+    ):
+        expects(len(stores) >= 1, "sharded tier needs at least one store")
+        dims = {s.dim for s in stores}
+        expects(len(dims) == 1, "per-shard stores disagree on dim: %s", dims)
+        self.stores = list(stores)
+        self.owner = np.ascontiguousarray(owner, dtype=np.int32)
+        self.local = np.ascontiguousarray(local, dtype=np.int32)
+        expects(
+            self.owner.shape == self.local.shape and self.owner.ndim == 1,
+            "owner/local must be matching 1-D row maps",
+        )
+        # staging: shape -> [buf_a, buf_b]; _flip picks the live one
+        self._staging = {}
+        self._flip = 0
+
+    @classmethod
+    def from_lists(
+        cls,
+        index,
+        data,
+        n_shards: int,
+        *,
+        fetch_depth_rows: Optional[int] = None,
+        readahead: bool = True,
+        retry_policy=None,
+    ) -> "ShardedHostTier":
+        """Split ``data [n_rows, dim]`` into per-shard stores following
+        the lists-sharded ownership: shard ``s`` owns the rows of lists
+        ``[s*l_local, (s+1)*l_local)`` — exactly the slice its device
+        scans, so every candidate a shard can emit is resident on that
+        shard's host. Rows dropped from the padded list layout (list-cap
+        overflow) own no shard; they can never be emitted by a scan."""
+        li = np.asarray(index.list_indices)
+        L = int(li.shape[0])
+        expects(L % n_shards == 0, "n_lists %d not divisible by %d shards", L, n_shards)
+        l_local = L // n_shards
+        data = np.asarray(data)
+        expects(data.ndim == 2, "sharded tier needs [n_rows, dim] data")
+        n_rows = int(data.shape[0])
+        owner = np.full(n_rows, -1, np.int32)
+        local = np.zeros(n_rows, np.int32)
+        stores = []
+        kw = {} if retry_policy is None else {"retry_policy": retry_policy}
+        for s in range(n_shards):
+            ids = li[s * l_local : (s + 1) * l_local].reshape(-1)
+            ids = ids[ids >= 0].astype(np.int64)
+            owner[ids] = s
+            local[ids] = np.arange(ids.size, dtype=np.int32)
+            stores.append(
+                HostVectorStore(
+                    np.ascontiguousarray(data[ids]),
+                    fetch_depth_rows=fetch_depth_rows,
+                    readahead=readahead,
+                    fault_context={"shard": s},
+                    **kw,
+                )
+            )
+        return cls(stores, owner, local)
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.stores)
+
+    @property
+    def dim(self) -> int:
+        return self.stores[0].dim
+
+    @property
+    def dtype(self):
+        return self.stores[0].dtype
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.owner.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        return sum(s.nbytes for s in self.stores)
+
+    def _staging_slab(self, shape) -> np.ndarray:
+        bufs = self._staging.get(shape)
+        if bufs is None:
+            bufs = [np.empty(shape, self.dtype) for _ in range(2)]
+            self._staging[shape] = bufs
+        self._flip ^= 1
+        return bufs[self._flip]
+
+    def gather_masked(
+        self, candidates: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, Tuple[int, ...]]:
+        """Gather candidate rows (global ids, ``-1`` = invalid) from
+        their owning shards' tiers.
+
+        Returns ``(slab [nq, n_cand, dim], cand [nq, n_cand] i32,
+        failed_shards)``. Candidates owned by a shard whose tier fetch
+        failed (typed :class:`HostFetchError` after retries) come back
+        masked to ``-1`` in ``cand`` — the re-rank demotes them, so one
+        dead host degrades coverage instead of hanging the merge, and
+        healthy shards keep exact id-parity."""
+        c = np.asarray(candidates, np.int32)
+        expects(c.ndim == 2, "candidates must be [nq, n_cand]")
+        valid = c >= 0
+        safe = np.where(valid, c, 0)
+        own = self.owner[safe]
+        loc = self.local[safe]
+        slab = self._staging_slab(c.shape + (self.dim,))
+        slab[...] = 0
+        cand = c.copy()
+        failed = []
+        for s, store in enumerate(self.stores):
+            mask = valid & (own == s)
+            if not mask.any():
+                continue
+            try:
+                slab[mask] = store.gather_rows(loc[mask])
+            except HostFetchError:
+                failed.append(s)
+                cand[mask] = -1
+                obs.inc("tiered.tier_failures", shard=str(s))
+        return slab, cand, tuple(failed)
+
+
+class TieredShardedIndex:
+    """One lists-sharded device index + its per-shard host tiers.
+
+    ``algo`` picks the sharded scan ("ivf_flat" or "ivf_pq_lists" —
+    the lists-sharded engines whose candidates carry global row ids);
+    ``index`` is the single built index whose components
+    :func:`~raft_tpu.parallel.sharded_ann.sharded_ivf_pq_lists_search`
+    shards over ``mesh`` axis ``axis``; ``tier`` is the matching
+    :class:`ShardedHostTier`. ``search`` returns a
+    :class:`~raft_tpu.robust.degrade.DegradedResult`.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        algo: str,
+        index,
+        tier: ShardedHostTier,
+        *,
+        axis: str = "data",
+        refine_ratio: int = 8,
+        micro_batch: int = 256,
+        search_params=None,
+        merge_mode: str = "auto",
+        metric_arg: float = 2.0,
+    ):
+        expects(algo in ALGOS, "tiered sharded algo must be one of %s, got %r",
+                ALGOS, algo)
+        expects(refine_ratio >= 1, "refine_ratio must be >= 1")
+        expects(micro_batch >= 1, "micro_batch must be >= 1")
+        n_shards = mesh.shape[axis]
+        expects(
+            tier.n_shards == n_shards,
+            "tier has %d shards for a %d-shard mesh", tier.n_shards, n_shards,
+        )
+        expects(
+            tier.n_rows >= int(index.size),
+            "tier row map covers %d rows for an index of size %d",
+            tier.n_rows, int(index.size),
+        )
+        self.mesh = mesh
+        self.algo = algo
+        self.index = index
+        self.tier = tier
+        self.axis = axis
+        self.refine_ratio = int(refine_ratio)
+        self.micro_batch = int(micro_batch)
+        self.search_params = search_params
+        self.merge_mode = merge_mode
+        self.metric_arg = float(metric_arg)
+
+    @property
+    def size(self) -> int:
+        return int(self.index.size)
+
+    @property
+    def dim(self) -> int:
+        return self.tier.dim
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    @property
+    def metric(self):
+        return resolve_metric(self.index.metric)
+
+    # label under which the robust.* degradation metrics are emitted
+    @property
+    def _robust_algo(self) -> str:
+        return f"tiered_{self.algo}"
+
+    def _scan(self, queries, kk: int, merge_mode: str, health):
+        """Dispatch the sharded scan for ``kk`` global candidates.
+        Returns replicated device arrays without syncing."""
+        from raft_tpu.parallel import sharded_ann
+
+        search = (
+            sharded_ann.sharded_ivf_flat_search if self.algo == "ivf_flat"
+            else sharded_ann.sharded_ivf_pq_lists_search
+        )
+        return search(
+            self.mesh, self.index, queries, kk, self.search_params,
+            axis=self.axis, health=health, merge_mode=merge_mode,
+        )
+
+    def search(
+        self,
+        queries,
+        k: int,
+        *,
+        overlap: bool = True,
+        micro_batch: Optional[int] = None,
+        merge_mode: Optional[str] = None,
+        health: Optional[Sequence[bool]] = None,
+        min_coverage: float = 0.0,
+    ):
+        """Tiered sharded search -> :class:`DegradedResult`.
+
+        ``health`` masks scan-side shards exactly as
+        :func:`raft_tpu.robust.degrade.sharded_search_degraded` does
+        (``None`` = all healthy, no probe — the serving engine owns
+        probing); tier-side failures are detected in-line by the gather.
+        Raises :class:`ShardFailure` when no shard is healthy or the
+        combined scan+tier coverage falls below ``min_coverage``."""
+        from raft_tpu.robust.degrade import DegradedResult
+
+        queries = np.asarray(queries)
+        expects(
+            queries.ndim == 2 and queries.shape[1] == self.dim, "bad query shape"
+        )
+        expects(1 <= k <= self.size, "k=%d out of range for index of size %d",
+                k, self.size)
+        kk = min(k * self.refine_ratio, self.size)
+        mode = merge_mode if merge_mode is not None else self.merge_mode
+        n_shards = self.n_shards
+
+        if health is not None:
+            health = tuple(bool(h) for h in health)
+            expects(len(health) == n_shards, "health mask has %d entries for %d shards",
+                    len(health), n_shards)
+        n_scan_ok = n_shards if health is None else sum(health)
+        scan_failed = () if health is None else tuple(
+            s for s, ok in enumerate(health) if not ok
+        )
+        if n_scan_ok == 0:
+            obs.inc("robust.queries_failed", algo=self._robust_algo)
+            raise ShardFailure(f"all {n_shards} shards unhealthy", shard=-1)
+        if n_scan_ok / n_shards < min_coverage:
+            obs.inc("robust.queries_failed", algo=self._robust_algo)
+            raise ShardFailure(
+                f"coverage {n_scan_ok / n_shards:.2f} below required "
+                f"{min_coverage:.2f} (failed shards: {scan_failed})",
+                shard=scan_failed[0],
+            )
+        # all-healthy uses the unmasked (pre-existing, bit-identical) program
+        scan_health = health if n_scan_ok < n_shards else None
+
+        mb = int(micro_batch or self.micro_batch)
+        nq = queries.shape[0]
+        spans = [(s, min(s + mb, nq)) for s in range(0, nq, mb)]
+        failed_tiers = set()
+
+        if obs.is_enabled():
+            obs.inc("tiered.search.calls", algo=f"sharded_{self.algo}")
+            obs.inc("tiered.search.queries", float(nq))
+
+        def consume(i, cand_np):
+            s, e = spans[i]
+            t0 = time.perf_counter()
+            slab, cand, failed = self.tier.gather_masked(cand_np)
+            dt = time.perf_counter() - t0
+            failed_tiers.update(failed)
+            # span measures enqueue only (no sync): the pipeline owns the
+            # block point, and forcing one here would serialize the overlap
+            with obs.span("tiered.refine", nq=int(e - s), k=int(k)):
+                out = _refine_gathered_impl(
+                    slab, queries[s:e], cand,
+                    k=k, metric=self.metric, metric_arg=self.metric_arg,
+                )
+            return out, dt
+
+        with obs.span(
+            "tiered.sharded.search",
+            algo=self.algo, nq=int(nq), k=int(k), n_shards=int(n_shards),
+        ):
+            if not overlap or len(spans) == 1:
+                outs = []
+                for i, (s, e) in enumerate(spans):
+                    _, cand = self._scan(queries[s:e], kk, mode, scan_health)
+                    # Sequential (non-overlapped) tier: the documented fallback
+                    # shape — the device idles during the host gather here by
+                    # design, which is exactly what overlap=True removes.
+                    cand_np = np.asarray(cand)  # graft-lint: ignore[sync-transfer-in-loop]
+                    outs.append(consume(i, cand_np)[0])
+                eff = 0.0
+            else:
+                outs, eff = run_overlapped(
+                    len(spans),
+                    lambda i: self._scan(
+                        queries[spans[i][0]:spans[i][1]], kk, mode, scan_health
+                    ),
+                    consume,
+                )
+            if obs.is_enabled():
+                obs.set_gauge("tiered.overlap_efficiency", eff)
+        d, ids = _collect(outs)
+
+        ok = [
+            s for s in range(n_shards)
+            if (health is None or health[s]) and s not in failed_tiers
+        ]
+        coverage = len(ok) / n_shards
+        failed = tuple(sorted(set(scan_failed) | failed_tiers))
+        if coverage < min_coverage:
+            obs.inc("robust.queries_failed", algo=self._robust_algo)
+            raise ShardFailure(
+                f"coverage {coverage:.2f} below required {min_coverage:.2f} "
+                f"(failed shards: {failed})",
+                shard=failed[0] if failed else -1,
+            )
+        degraded = coverage < 1.0
+        obs.set_gauge("robust.shards_healthy", len(ok), algo=self._robust_algo)
+        if degraded:
+            obs.inc("robust.degraded_queries", algo=self._robust_algo)
+        return DegradedResult(
+            distances=d, indices=ids, coverage=coverage,
+            degraded=degraded, failed_shards=failed,
+        )

@@ -1,4 +1,4 @@
-"""The host tier: raw vectors in host RAM (or mmap), gathered per batch.
+"""The host tier: raw vectors in host RAM (or mmap/SSD), gathered per batch.
 
 A :class:`HostVectorStore` stands in for the ``dataset`` argument of
 :func:`raft_tpu.neighbors.refine.refine` (and the integrated refine of
@@ -9,15 +9,36 @@ transfers up. Rows never touch HBM except as the ``[batch, n_cand, dim]``
 winner slab — which is what lets a corpus exceed device memory by the
 inverse of its code compression ratio.
 
+The gather core (:meth:`HostVectorStore.gather_rows`) carries the two
+knobs that make the mmap path an SSD-backed tier rather than a page-fault
+lottery:
+
+* **read-ahead hints** — candidate row ids are coalesced into page-aligned
+  byte ranges and advertised to the OS via ``madvise(MADV_WILLNEED)``
+  before the copy touches them, so cold pages stream in ahead of the
+  sequential ``np.take`` instead of faulting one row at a time;
+* **fetch-depth budget** — ``fetch_depth_rows`` caps in-flight gather
+  rows: the copy proceeds in bounded chunks with the *next* chunk's
+  read-ahead issued before the current chunk is copied, bounding both the
+  page-in burst and the window a stalled device sees.
+
+Duplicate candidate ids within a batch (shared winners across queries)
+are coalesced: the tier is read once per unique row and the slab filled
+by an in-RAM scatter — ``tiered.fetch.dedup_rows`` counts the rows (and
+therefore bytes) that never crossed the tier.
+
 Every gather crosses the ``host.fetch`` fault seam (latency injection
 lands inside the timed fetch window, so chaos tests can watch the
 overlap pipeline absorb it) and is retried with seeded backoff before
-surfacing a typed :class:`raft_tpu.core.errors.HostFetchError`.
+surfacing a typed :class:`raft_tpu.core.errors.HostFetchError`. A store
+constructed with a ``fault_context`` (e.g. ``{"shard": 2}`` by
+:class:`raft_tpu.tiered.sharded.ShardedHostTier`) tags every fire with
+it, so chaos specs can target one shard's tier via ``match=``.
 """
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -42,12 +63,18 @@ class HostVectorStore:
 
     ``data`` may be any numpy array (kept as-is, C-contiguous copy only
     if needed) or an ``np.memmap`` from :meth:`open` — the gather path
-    is identical, the OS pages mmap rows in on first touch.
+    is identical, the OS pages mmap rows in on first touch (read-ahead
+    hints move that touch off the copy's critical path).
 
     The staging slab is double-buffered: ``gather`` alternates between
     two host buffers per result shape, so the overlap pipeline can hand
     slab N to the device while slab N+1 is being filled without either
     copy racing the other.
+
+    ``fetch_depth_rows`` bounds in-flight gather rows per chunk (None =
+    unbounded, one chunk); ``readahead`` gates the madvise hints on the
+    mmap path; ``fault_context`` is merged into every ``host.fetch``
+    fault fire so chaos specs can match one store among many.
     """
 
     #: duck-type marker consumed by :func:`raft_tpu.neighbors.refine.is_host_dataset`
@@ -59,13 +86,23 @@ class HostVectorStore:
         *,
         retry_policy: RetryPolicy = FETCH_RETRY,
         source_path: Optional[str] = None,
+        fetch_depth_rows: Optional[int] = None,
+        readahead: bool = True,
+        fault_context: Optional[Dict[str, object]] = None,
     ):
         if not isinstance(data, np.memmap):
             data = np.ascontiguousarray(data)
         expects(data.ndim == 2, "host vector store needs [n_rows, dim] data")
+        expects(
+            fetch_depth_rows is None or fetch_depth_rows >= 1,
+            "fetch_depth_rows must be >= 1 (or None for unbounded)",
+        )
         self._data = data
         self._retry = retry_policy
         self.source_path = source_path
+        self.fetch_depth_rows = fetch_depth_rows
+        self.readahead = bool(readahead)
+        self._fault_context = dict(fault_context or {})
         # staging: shape -> [buf_a, buf_b]; _flip picks the live one
         self._staging = {}
         self._flip = 0
@@ -109,40 +146,119 @@ class HostVectorStore:
         self._flip ^= 1
         return bufs[self._flip]
 
+    def _advise(self, rows: np.ndarray) -> None:
+        """madvise(WILLNEED) the page-aligned byte ranges covering
+        ``rows`` of the backing mmap, coalescing ids whose ranges sit
+        within one page of each other. Best-effort: a store that is not
+        mmap-backed, a platform without madvise, or any OS-level refusal
+        degrades to the plain demand-paged copy."""
+        if not self.readahead or rows.size == 0 or not self.is_mmap:
+            return
+        mm = getattr(self._data, "_mmap", None)
+        if mm is None or not hasattr(mm, "madvise"):
+            return
+        import mmap as _mmap
+
+        if not hasattr(_mmap, "MADV_WILLNEED"):
+            return
+        page = _mmap.ALLOCATIONGRANULARITY
+        row_b = int(self._data.strides[0])
+        base = int(getattr(self._data, "offset", 0))
+        srt = np.sort(np.asarray(rows, np.int64))
+        starts = base + srt * row_b
+        ends = starts + row_b
+        # merge runs whose gap is under one page — one hint per run
+        brk = np.nonzero(starts[1:] > ends[:-1] + page)[0] + 1
+        run_s = starts[np.concatenate(([0], brk))]
+        run_e = ends[np.concatenate((brk - 1, [srt.size - 1]))]
+        total = len(mm)
+        n_hints = 0
+        try:
+            for s, e in zip(run_s, run_e):
+                a = (int(s) // page) * page
+                length = min(int(e), total) - a
+                if length <= 0:
+                    continue
+                mm.madvise(_mmap.MADV_WILLNEED, a, length)
+                n_hints += 1
+        except (OSError, ValueError):
+            return  # hints are advisory; the copy below still works
+        if n_hints and obs.is_enabled():
+            obs.inc("tiered.fetch.readahead_ranges", float(n_hints))
+
+    def _read_rows(self, rows: np.ndarray, dest: np.ndarray) -> None:
+        """Copy ``rows`` (1-D valid ids) into ``dest [len(rows), dim]``
+        under the fetch-depth budget: chunked ``np.take`` with the NEXT
+        chunk's read-ahead issued before the current chunk's copy, so
+        page-in overlaps the memcpy instead of serializing behind it."""
+        n = int(rows.size)
+        depth = self.fetch_depth_rows or n or 1
+        self._advise(rows[:depth])
+        for s in range(0, n, depth):
+            e = min(s + depth, n)
+            if e < n:
+                self._advise(rows[e : min(e + depth, n)])
+            np.take(self._data, rows[s:e], axis=0, out=dest[s:e])
+
+    def gather_rows(self, rows, out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Fetch ``rows`` (1-D valid ids) into ``out [len(rows), dim]``
+        (allocated when None): the dedup'd, depth-budgeted, read-ahead
+        gather core behind :meth:`gather`, also driven directly by
+        :class:`raft_tpu.tiered.sharded.ShardedHostTier` with a scatter
+        destination per shard.
+
+        Duplicate ids are fetched once (``tiered.fetch.dedup_rows``
+        counts the coalesced rows); ``tiered.fetch.rows`` /
+        ``tiered.fetch.bytes`` count what actually crossed the tier.
+        Crosses the ``host.fetch`` fault seam under retry; timed into
+        ``tiered.fetch_ms`` and a ``host.fetch`` span."""
+        rows = np.asarray(rows).reshape(-1)
+        if out is None:
+            out = np.empty((rows.size, self.dim), self._data.dtype)
+        uniq, inverse = np.unique(rows, return_inverse=True)
+        dedup = uniq.size < rows.size
+        fetch = uniq if dedup else rows
+        dest = np.empty((fetch.size, self.dim), self._data.dtype) if dedup else out
+        t0 = time.perf_counter()
+
+        def _fetch():
+            faults.fire("host.fetch", rows=int(fetch.size), **self._fault_context)
+            self._read_rows(fetch, dest)
+            return dest
+
+        try:
+            with obs.span("host.fetch", rows=int(fetch.size)):
+                retry_call(_fetch, policy=self._retry, op="host.fetch")
+        except RetryError as e:
+            raise HostFetchError(
+                "host-tier vector fetch failed",
+                rows=int(fetch.size), attempts=e.attempts,
+            ) from e.last
+        if dedup:
+            np.take(dest, inverse, axis=0, out=out)
+        if obs.is_enabled():
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            row_bytes = self.dim * self._data.dtype.itemsize
+            obs.inc("tiered.fetch.rows", float(fetch.size))
+            obs.inc("tiered.fetch.bytes", float(fetch.size * row_bytes))
+            if dedup:
+                obs.inc("tiered.fetch.dedup_rows", float(rows.size - uniq.size))
+            obs.observe("tiered.fetch_ms", dt_ms)
+        return out
+
     def gather(self, candidates: np.ndarray) -> np.ndarray:
         """Fetch the candidate rows: ``[nq, n_cand] i32`` ids (-1 =
         invalid, substituted by row 0 exactly like the device gather in
         ``refine._refine_impl``) -> ``[nq, n_cand, dim]`` staging slab.
 
-        Counted in ``tiered.fetch.rows`` / ``tiered.fetch.bytes``, timed
-        into the ``tiered.fetch_ms`` histogram and a ``host.fetch`` span
-        (trace-tagged when a request trace scope is active); crosses the
-        ``host.fetch`` fault seam under retry."""
+        See :meth:`gather_rows` for the dedup / read-ahead / retry /
+        metrics contract of the fetch itself."""
         c = np.asarray(candidates)
         expects(c.ndim == 2, "candidates must be [nq, n_cand]")
         safe = np.where(c >= 0, c, 0).reshape(-1)
         out = self._staging_slab(c.shape + (self.dim,))
-        t0 = time.perf_counter()
-
-        def _fetch():
-            faults.fire("host.fetch", rows=int(safe.size))
-            np.take(self._data, safe, axis=0, out=out.reshape(-1, self.dim))
-            return out
-
-        try:
-            with obs.span("host.fetch", rows=int(safe.size), nq=int(c.shape[0])):
-                slab = retry_call(_fetch, policy=self._retry, op="host.fetch")
-        except RetryError as e:
-            raise HostFetchError(
-                "host-tier vector fetch failed",
-                rows=int(safe.size), attempts=e.attempts,
-            ) from e.last
-        if obs.is_enabled():
-            dt_ms = (time.perf_counter() - t0) * 1e3
-            obs.inc("tiered.fetch.rows", float(safe.size))
-            obs.inc("tiered.fetch.bytes", float(slab.nbytes))
-            obs.observe("tiered.fetch_ms", dt_ms)
-        return slab
+        self.gather_rows(safe, out=out.reshape(-1, self.dim))
+        return out
 
     # -- persistence ---------------------------------------------------------
 
@@ -169,17 +285,24 @@ class HostVectorStore:
         mmap: bool = True,
         verify_crc: bool = True,
         retry_policy: RetryPolicy = FETCH_RETRY,
+        fetch_depth_rows: Optional[int] = None,
+        readahead: bool = True,
     ) -> "HostVectorStore":
         """Open a snapshot written by :meth:`save`.
 
         ``mmap=True`` maps the npy payload read-only in place (CRC
         verified by streaming once up front unless ``verify_crc=False``)
-        — resident set grows only with the rows queries actually touch.
-        ``mmap=False`` materializes the array in host RAM."""
+        — resident set grows only with the rows queries actually touch;
+        read-ahead hints and the fetch-depth budget (see the class doc)
+        make this the SSD-backed tier. ``mmap=False`` materializes the
+        array in host RAM."""
         if mmap:
             _, offset, _ = ser.open_payload(path, _KIND, verify_crc=verify_crc)
             arr, _ = ser.mmap_array_at(path, offset)
-            return cls(arr, retry_policy=retry_policy, source_path=path)
+            return cls(
+                arr, retry_policy=retry_policy, source_path=path,
+                fetch_depth_rows=fetch_depth_rows, readahead=readahead,
+            )
         with open(path, "rb") as f:
             _, body = ser.load_stream(f, _KIND)
             name = ser.deserialize_string(body)
@@ -188,4 +311,7 @@ class HostVectorStore:
                 import jax.numpy as jnp
 
                 arr = arr.view(jnp.dtype(name))
-        return cls(arr, retry_policy=retry_policy, source_path=path)
+        return cls(
+            arr, retry_policy=retry_policy, source_path=path,
+            fetch_depth_rows=fetch_depth_rows, readahead=readahead,
+        )

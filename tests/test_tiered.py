@@ -25,17 +25,29 @@ import numpy as np
 import pytest
 
 from raft_tpu import obs
-from raft_tpu.core.errors import CorruptIndexError, HostFetchError, LogicError
+from raft_tpu.core.errors import (
+    CorruptIndexError,
+    HostFetchError,
+    LogicError,
+    ShardFailure,
+)
 from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
 from raft_tpu.ops.pallas.hbm_model import (
     HbmComponent,
     brute_force_residency,
     ivf_pq_residency,
     plan_placement,
+    plan_placement_sharded,
     residency_for_index,
+    staging_footprint,
 )
 from raft_tpu.robust import faults
-from raft_tpu.tiered import HostVectorStore, TieredIndex
+from raft_tpu.tiered import (
+    HostVectorStore,
+    ShardedHostTier,
+    TieredIndex,
+    TieredShardedIndex,
+)
 
 N, DIM, K, MB = 3000, 48, 10, 256
 
@@ -409,3 +421,535 @@ class TestTieredObs:
         assert counters["tiered.fetch.bytes"] > 0
         assert any(k.startswith("tiered.fetch_ms") for k in snap["histograms"])
         assert 0.0 <= gauges["tiered.overlap_efficiency"] <= 1.0
+
+
+# -- store fetch controls: dedup, depth budget, read-ahead ---------------------
+
+
+class TestStoreFetchControls:
+    def test_gather_rows_coalesces_duplicates(self, data):
+        store = HostVectorStore(data)
+        rows = np.array([5, 17, 5, 5, 42, 17], np.int32)
+        obs.enable()
+        try:
+            out = store.gather_rows(rows)
+            snap = obs.registry().as_dict()
+        finally:
+            obs.disable()
+            obs.registry().reset()
+        np.testing.assert_array_equal(out, data[rows])
+        counters = snap["counters"]
+        # 3 unique rows fetched, 3 duplicate slots served from the scatter
+        assert counters["tiered.fetch.rows"] == 3
+        assert counters["tiered.fetch.dedup_rows"] == 3
+        assert counters["tiered.fetch.bytes"] == 3 * DIM * 4
+
+    def test_gather_counts_only_unique_rows(self, data):
+        """`gather` (the candidate-slab wrapper) inherits the coalescing:
+        duplicate candidate ids cost one host read, not n."""
+        store = HostVectorStore(data)
+        cand = np.array([[7, 7, 7, 9], [9, 7, 7, 7]], np.int32)
+        obs.enable()
+        try:
+            slab = store.gather(cand)
+            snap = obs.registry().as_dict()
+        finally:
+            obs.disable()
+            obs.registry().reset()
+        np.testing.assert_array_equal(np.asarray(slab), data[cand])
+        assert snap["counters"]["tiered.fetch.rows"] == 2
+        assert snap["counters"]["tiered.fetch.dedup_rows"] == 6
+
+    @pytest.mark.parametrize("depth", [1, 7, 64, None])
+    def test_fetch_depth_budget_is_result_invariant(self, data, depth):
+        rng = np.random.default_rng(21)
+        rows = rng.integers(0, N, size=200).astype(np.int32)
+        budgeted = HostVectorStore(data, fetch_depth_rows=depth)
+        np.testing.assert_array_equal(budgeted.gather_rows(rows), data[rows])
+
+    def test_fetch_depth_validated(self, data):
+        with pytest.raises(LogicError):
+            HostVectorStore(data, fetch_depth_rows=0)
+
+    def test_mmap_readahead_hints_counted(self, tmp_path, data):
+        import mmap as mmap_mod
+
+        if not hasattr(mmap_mod, "MADV_WILLNEED"):
+            pytest.skip("madvise(MADV_WILLNEED) unavailable on this platform")
+        path = str(tmp_path / "vectors.bin")
+        HostVectorStore.save(path, data)
+        store = HostVectorStore.open(path, mmap=True, fetch_depth_rows=16)
+        rng = np.random.default_rng(22)
+        rows = rng.integers(0, N, size=100).astype(np.int32)
+        obs.enable()
+        try:
+            out = store.gather_rows(rows)
+            snap = obs.registry().as_dict()
+        finally:
+            obs.disable()
+            obs.registry().reset()
+        np.testing.assert_array_equal(out, data[rows])
+        assert snap["counters"]["tiered.fetch.readahead_ranges"] > 0
+
+    def test_readahead_opt_out(self, tmp_path, data):
+        path = str(tmp_path / "vectors.bin")
+        HostVectorStore.save(path, data)
+        store = HostVectorStore.open(path, mmap=True, readahead=False)
+        obs.enable()
+        try:
+            out = store.gather_rows(np.arange(50, dtype=np.int32))
+            snap = obs.registry().as_dict()
+        finally:
+            obs.disable()
+            obs.registry().reset()
+        np.testing.assert_array_equal(out, data[:50])
+        assert "tiered.fetch.readahead_ranges" not in snap["counters"]
+
+    def test_fault_context_targets_one_store(self, data):
+        healthy = HostVectorStore(data[:100], fault_context={"shard": 0})
+        doomed = HostVectorStore(data[:100], fault_context={"shard": 1})
+        rows = np.arange(10, dtype=np.int32)
+        with faults.injected("host.fetch", error=OSError("host down"),
+                             match={"shard": 1}):
+            np.testing.assert_array_equal(healthy.gather_rows(rows), data[:10])
+            with pytest.raises(HostFetchError):
+                doomed.gather_rows(rows)
+
+
+# -- pod-scale: per-shard tiers behind the ring merge --------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh4(eight_devices):
+    from raft_tpu.parallel.comms import make_mesh
+
+    return make_mesh(eight_devices[:4])
+
+
+@pytest.fixture(scope="module")
+def sharded_pq(data):
+    idx = ivf_pq.build(
+        data, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16, kmeans_n_iters=5, seed=4)
+    )
+    return idx, ivf_pq.IvfPqSearchParams(n_probes=8)
+
+
+@pytest.fixture(scope="module")
+def sharded_flat(data):
+    idx = ivf_flat.build(
+        data, ivf_flat.IvfFlatIndexParams(n_lists=8, kmeans_n_iters=5, seed=5)
+    )
+    return idx, ivf_flat.IvfFlatSearchParams(n_probes=8)
+
+
+def _resident_sharded(mesh, algo, idx, sp, data, q, kk, k, merge_mode, health=None):
+    """The parity baseline: resident sharded scan for ``kk`` global
+    candidates + device refine to ``k`` over the full dataset."""
+    from raft_tpu.neighbors.refine import refine
+    from raft_tpu.parallel import sharded_ann
+
+    search = (
+        sharded_ann.sharded_ivf_flat_search if algo == "ivf_flat"
+        else sharded_ann.sharded_ivf_pq_lists_search
+    )
+    _, cand = search(mesh, idx, q, kk, sp, health=health, merge_mode=merge_mode)
+    cand = np.asarray(cand)
+    d, i = refine(data, q, cand, k, metric=idx.metric)
+    return np.asarray(d), np.asarray(i), cand
+
+
+class TestShardedHostTier:
+    def test_from_lists_follows_list_ownership(self, data, sharded_pq):
+        idx, _ = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        assert tier.n_shards == 4 and tier.dim == DIM and tier.n_rows == N
+        li = np.asarray(idx.list_indices)
+        l_local = li.shape[0] // 4
+        for s in range(4):
+            ids = li[s * l_local : (s + 1) * l_local].reshape(-1)
+            ids = ids[ids >= 0]
+            assert (tier.owner[ids] == s).all()
+            # each store holds exactly its shard's rows, locally indexed
+            np.testing.assert_array_equal(
+                np.asarray(tier.stores[s]._data)[tier.local[ids]], data[ids]
+            )
+        assert tier.nbytes == sum(s.nbytes for s in tier.stores)
+
+    def test_n_lists_must_divide(self, data, sharded_pq):
+        idx, _ = sharded_pq  # 8 lists
+        with pytest.raises(LogicError):
+            ShardedHostTier.from_lists(idx, data, 3)
+
+    def test_gather_masked_routes_to_owners(self, data, sharded_pq):
+        idx, _ = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        rng = np.random.default_rng(23)
+        cand = rng.integers(0, N, size=(6, 9)).astype(np.int32)
+        cand[0, 3] = cand[2, 0] = -1  # invalid slots survive the routing
+        slab, out_cand, failed = tier.gather_masked(cand)
+        assert failed == ()
+        np.testing.assert_array_equal(out_cand, cand)
+        valid = cand >= 0
+        np.testing.assert_array_equal(np.asarray(slab)[valid], data[cand[valid]])
+        assert not np.asarray(slab)[~valid].any()  # invalid slots zeroed
+
+    def test_gather_masked_coalesces_within_shard(self, data, sharded_pq):
+        idx, _ = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        rid = int(np.nonzero(tier.owner == 2)[0][0])
+        cand = np.array([[rid, rid, rid, rid]], np.int32)
+        obs.enable()
+        try:
+            slab, _, failed = tier.gather_masked(cand)
+            snap = obs.registry().as_dict()
+        finally:
+            obs.disable()
+            obs.registry().reset()
+        assert failed == ()
+        np.testing.assert_array_equal(np.asarray(slab)[0], data[[rid] * 4])
+        assert snap["counters"]["tiered.fetch.rows"] == 1
+        assert snap["counters"]["tiered.fetch.dedup_rows"] == 3
+
+    def test_dead_tier_masks_only_its_candidates(self, data, sharded_pq):
+        idx, _ = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        rng = np.random.default_rng(24)
+        cand = rng.integers(0, N, size=(5, 8)).astype(np.int32)
+        obs.enable()
+        try:
+            with faults.injected("host.fetch", error=OSError("dead host"),
+                                 match={"shard": 1}):
+                slab, out_cand, failed = tier.gather_masked(cand)
+            snap = obs.registry().as_dict()
+        finally:
+            obs.disable()
+            obs.registry().reset()
+        assert failed == (1,)
+        owned = tier.owner[cand] == 1
+        assert (out_cand[owned] == -1).all()
+        np.testing.assert_array_equal(out_cand[~owned], cand[~owned])
+        surviving = ~owned & (cand >= 0)
+        np.testing.assert_array_equal(
+            np.asarray(slab)[surviving], data[cand[surviving]]
+        )
+        assert snap["counters"]['tiered.tier_failures{shard="1"}'] >= 1
+
+
+class TestTieredSharded:
+    @pytest.mark.parametrize("merge_mode", ["ring", "gather"])
+    @pytest.mark.parametrize("overlap", [True, False])
+    def test_bit_parity_with_resident_sharded(
+        self, data, queries, mesh4, sharded_pq, merge_mode, overlap
+    ):
+        idx, sp = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        tsi = TieredShardedIndex(
+            mesh4, "ivf_pq_lists", idx, tier,
+            refine_ratio=8, micro_batch=16, search_params=sp,
+        )
+        q = queries[:64]
+        d_ref, i_ref, _ = _resident_sharded(
+            mesh4, "ivf_pq_lists", idx, sp, data, q, K * 8, K, merge_mode
+        )
+        res = tsi.search(q, K, overlap=overlap, merge_mode=merge_mode)
+        assert res.coverage == 1.0 and not res.degraded and res.failed_shards == ()
+        np.testing.assert_array_equal(np.asarray(res.indices), i_ref)
+        np.testing.assert_array_equal(np.asarray(res.distances), d_ref)
+
+    def test_ivf_flat_parity(self, data, queries, mesh4, sharded_flat):
+        idx, sp = sharded_flat
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        tsi = TieredShardedIndex(
+            mesh4, "ivf_flat", idx, tier,
+            refine_ratio=8, micro_batch=16, search_params=sp,
+        )
+        q = queries[:48]
+        d_ref, i_ref, _ = _resident_sharded(
+            mesh4, "ivf_flat", idx, sp, data, q, K * 8, K, "ring"
+        )
+        res = tsi.search(q, K, merge_mode="ring")
+        assert res.coverage == 1.0
+        np.testing.assert_array_equal(np.asarray(res.indices), i_ref)
+        np.testing.assert_array_equal(np.asarray(res.distances), d_ref)
+
+    def test_scan_health_exclusion_parity(self, data, queries, mesh4, sharded_pq):
+        """A scan-side health mask demotes the shard inside the merge
+        exactly as the masked resident program does."""
+        idx, sp = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        tsi = TieredShardedIndex(
+            mesh4, "ivf_pq_lists", idx, tier,
+            refine_ratio=8, micro_batch=16, search_params=sp,
+        )
+        q = queries[:32]
+        health = (True, False, True, True)
+        d_ref, i_ref, _ = _resident_sharded(
+            mesh4, "ivf_pq_lists", idx, sp, data, q, K * 8, K, "ring", health=health
+        )
+        res = tsi.search(q, K, merge_mode="ring", health=health)
+        assert res.degraded and res.coverage == 0.75
+        assert res.failed_shards == (1,)
+        np.testing.assert_array_equal(np.asarray(res.indices), i_ref)
+        np.testing.assert_array_equal(np.asarray(res.distances), d_ref)
+
+    def test_dead_host_tier_degrades_not_hangs(self, data, queries, mesh4, sharded_pq):
+        """The chaos acceptance case: one shard's host tier dies under
+        ``merge_mode="ring"``. The ring must complete, coverage drops to
+        3/4, and every candidate owned by a healthy shard keeps exact
+        id-parity with the baseline that masks the dead shard's rows."""
+        idx, sp = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        tsi = TieredShardedIndex(
+            mesh4, "ivf_pq_lists", idx, tier,
+            refine_ratio=8, micro_batch=16, search_params=sp,
+        )
+        q = queries[:48]
+        with faults.injected("host.fetch", error=OSError("dead host"),
+                             match={"shard": 1}):
+            res = tsi.search(q, K, merge_mode="ring")
+        assert res.degraded and res.coverage == 0.75
+        assert res.failed_shards == (1,)
+        # baseline: same scan, dead shard's candidates masked before refine
+        from raft_tpu.neighbors.refine import refine
+
+        _, _, cand = _resident_sharded(
+            mesh4, "ivf_pq_lists", idx, sp, data, q, K * 8, K, "ring"
+        )
+        owner = tier.owner[np.where(cand >= 0, cand, 0)]
+        masked = np.where((cand >= 0) & (owner == 1), -1, cand)
+        d_ref, i_ref = refine(data, q, masked, K, metric=idx.metric)
+        np.testing.assert_array_equal(np.asarray(res.indices), np.asarray(i_ref))
+        np.testing.assert_array_equal(np.asarray(res.distances), np.asarray(d_ref))
+
+    def test_tier_latency_stall_never_changes_results(
+        self, data, queries, mesh4, sharded_pq
+    ):
+        idx, sp = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        tsi = TieredShardedIndex(
+            mesh4, "ivf_pq_lists", idx, tier,
+            refine_ratio=8, micro_batch=16, search_params=sp,
+        )
+        q = queries[:48]
+        clean = tsi.search(q, K, merge_mode="ring")
+        with faults.injected("host.fetch", latency_s=0.01, match={"shard": 2}):
+            stalled = tsi.search(q, K, merge_mode="ring", overlap=True)
+        assert stalled.coverage == 1.0 and not stalled.degraded
+        np.testing.assert_array_equal(
+            np.asarray(stalled.indices), np.asarray(clean.indices)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stalled.distances), np.asarray(clean.distances)
+        )
+
+    def test_min_coverage_floor(self, data, queries, mesh4, sharded_pq):
+        idx, sp = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        tsi = TieredShardedIndex(
+            mesh4, "ivf_pq_lists", idx, tier,
+            refine_ratio=8, micro_batch=16, search_params=sp,
+        )
+        q = queries[:16]
+        with pytest.raises(ShardFailure):
+            tsi.search(q, K, health=(False, False, False, False))
+        with pytest.raises(ShardFailure, match="coverage"):
+            tsi.search(q, K, health=(True, False, False, False), min_coverage=0.5)
+        # tier-side failures count against the same floor, post-gather
+        with faults.injected("host.fetch", error=OSError("dead host"),
+                             match={"shard": 1}):
+            with pytest.raises(ShardFailure, match="coverage"):
+                tsi.search(q, K, merge_mode="ring", min_coverage=0.9)
+
+    def test_obs_counters_and_overlap_gauge(self, data, queries, mesh4, sharded_pq):
+        idx, sp = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        tsi = TieredShardedIndex(
+            mesh4, "ivf_pq_lists", idx, tier,
+            refine_ratio=8, micro_batch=16, search_params=sp,
+        )
+        obs.enable()
+        try:
+            tsi.search(queries[:64], K, merge_mode="ring")
+            snap = obs.registry().as_dict()
+        finally:
+            obs.disable()
+            obs.registry().reset()
+        counters, gauges = snap["counters"], snap["gauges"]
+        assert counters['tiered.search.calls{algo="sharded_ivf_pq_lists"}'] == 1
+        assert counters["tiered.search.queries"] == 64
+        assert counters["tiered.fetch.rows"] > 0
+        assert 0.0 <= gauges["tiered.overlap_efficiency"] <= 1.0
+        assert gauges['robust.shards_healthy{algo="tiered_ivf_pq_lists"}'] == 4
+
+
+# -- serving engine: per-shard three-level planning ----------------------------
+
+
+class TestEngineShardedTier:
+    def _per_shard_required(self, idx, n_shards):
+        res = residency_for_index("s", "ivf_pq", idx, refine_rows=N)
+        return sum(
+            c.per_shard_bytes(n_shards) for c in res.components if c.required
+        )
+
+    def test_over_budget_sharded_registration_converts(
+        self, data, queries, mesh4, sharded_pq
+    ):
+        from raft_tpu.serve.engine import ServingEngine
+
+        idx, sp = sharded_pq
+        budget = int(self._per_shard_required(idx, 4) / 0.9) + (16 << 10)
+        eng = ServingEngine(max_batch=32, hbm_budget_bytes=budget)
+        eng.register(
+            "s", "sharded_ivf_pq_lists", idx, params=sp, dataset=data,
+            mesh=mesh4, merge_mode="ring", refine_ratio=8, micro_batch=16,
+        )
+        reg = eng._indexes["s"]
+        assert reg.algo == "tiered_sharded"
+        assert isinstance(reg.index, TieredShardedIndex)
+        placement = eng.sharded_placements["s"]
+        assert placement.spilled("s")
+        assert placement.tier("s", "raw_vectors") == "host"
+        fut = eng.submit("s", queries[:8], k=K)
+        eng.run_until_idle()
+        out = fut.result()
+        assert out.coverage == 1.0 and not out.degraded
+        d_ref, i_ref, _ = _resident_sharded(
+            mesh4, "ivf_pq_lists", idx, sp, data, queries[:8], K * 8, K, "ring"
+        )
+        np.testing.assert_array_equal(out.indices, i_ref)
+
+    def test_under_budget_sharded_registration_stays_resident(
+        self, data, mesh4, sharded_pq
+    ):
+        from raft_tpu.serve.engine import ServingEngine
+
+        idx, sp = sharded_pq
+        eng = ServingEngine(max_batch=32, hbm_budget_bytes=1 << 30)
+        eng.register(
+            "s", "sharded_ivf_pq_lists", idx, params=sp, dataset=data,
+            mesh=mesh4, merge_mode="ring",
+        )
+        reg = eng._indexes["s"]
+        assert reg.algo == "sharded_ivf_pq_lists"
+        assert eng.sharded_placements["s"].tier("s", "raw_vectors") == "device"
+
+    def test_infeasible_per_shard_budget_fails_typed(self, data, mesh4, sharded_pq):
+        from raft_tpu.serve.engine import ServingEngine
+
+        idx, sp = sharded_pq
+        eng = ServingEngine(hbm_budget_bytes=1024)
+        with pytest.raises(LogicError, match="scan-resident"):
+            eng.register(
+                "s", "sharded_ivf_pq_lists", idx, params=sp, dataset=data,
+                mesh=mesh4,
+            )
+
+    def test_register_prebuilt_tiered_sharded(self, data, queries, mesh4, sharded_pq):
+        from raft_tpu.serve.engine import ServingEngine
+
+        idx, sp = sharded_pq
+        tier = ShardedHostTier.from_lists(idx, data, 4)
+        tsi = TieredShardedIndex(
+            mesh4, "ivf_pq_lists", idx, tier,
+            refine_ratio=8, micro_batch=16, search_params=sp, merge_mode="ring",
+        )
+        eng = ServingEngine(max_batch=32)
+        eng.register("ts", "tiered_sharded", tsi)  # mesh inferred from index
+        fut = eng.submit("ts", queries[:8], k=K)
+        eng.run_until_idle()
+        out = fut.result()
+        assert out.coverage == 1.0
+        d_ref, i_ref, _ = _resident_sharded(
+            mesh4, "ivf_pq_lists", idx, sp, data, queries[:8], K * 8, K, "ring"
+        )
+        np.testing.assert_array_equal(out.indices, i_ref)
+
+
+# -- staging-slab + three-level placement accounting ---------------------------
+
+
+class TestStagingAccounting:
+    def test_replicated_components_cost_full_per_shard(self):
+        rep = HbmComponent("centers", (128, 64), 4, replicated=True)
+        shd = HbmComponent("codes", (128, 64), 4)
+        assert rep.per_shard_bytes(8) == rep.nbytes
+        assert shd.per_shard_bytes(8) == -(-shd.nbytes // 8)
+        assert shd.per_shard_bytes(1) == shd.nbytes
+
+    def test_flat_plan_staging_zero_when_resident(self):
+        res = brute_force_residency("r", n_rows=100, dim=32, refine_rows=100)
+        p = plan_placement([res], hbm_budget=1 << 30)
+        assert not p.spilled("r")
+        assert p.staging_host_bytes == 0 and p.staging_device_bytes == 0
+
+    def test_flat_plan_staging_charged_on_spill(self):
+        res = brute_force_residency("r", n_rows=4000, dim=32, refine_rows=4000)
+        budget = int(res.required_bytes / 0.9) + 1024
+        p = plan_placement([res], hbm_budget=budget)
+        assert p.spilled("r")
+        sh, sd = staging_footprint(32, 4)
+        assert p.staging_host_bytes == sh and p.staging_device_bytes == sd
+        # transfer slab is real HBM the operator must see; host total is
+        # the raw slab only (staging reported separately)
+        assert p.device_bytes == res.required_bytes + sd
+        assert p.host_bytes == res.optional_bytes
+        assert "staging" in p.table()
+
+    def test_sharded_plan_replicated_math(self):
+        pq = ivf_pq_residency(
+            "p", n_rows=100_000, dim=64, n_lists=64, pq_dim=16, pq_bits=8,
+            refine_rows=100_000,
+        )
+        p = plan_placement_sharded([pq], 8, hbm_budget_per_shard=1 << 30)
+        assert p.feasible and not p.spilled("p")
+        expected = sum(c.per_shard_bytes(8) for c in pq.components)
+        assert p.device_bytes_per_shard == expected
+        assert p.staging_host_bytes == 0 and p.staging_device_bytes == 0
+        # replicated components must dominate their sharded cost
+        for c in pq.components:
+            if c.replicated:
+                assert c.per_shard_bytes(8) == c.nbytes > -(-c.nbytes // 8) or c.nbytes < 8
+
+    def test_sharded_plan_spills_to_host_then_disk(self):
+        pq = ivf_pq_residency(
+            "p", n_rows=100_000, dim=64, n_lists=64, pq_dim=16, pq_bits=8,
+            refine_rows=100_000,
+        )
+        req_ps = sum(c.per_shard_bytes(8) for c in pq.components if c.required)
+        budget = int(req_ps / 0.9) + (16 << 10)
+        p = plan_placement_sharded([pq], 8, hbm_budget_per_shard=budget)
+        assert p.feasible and p.tier("p", "raw_vectors") == "host"
+        sh, sd = staging_footprint(64, 4)
+        assert p.staging_host_bytes == sh and p.staging_device_bytes == sd
+        assert p.host_bytes_per_shard > 0 and p.disk_bytes_per_shard == 0
+        tiny = plan_placement_sharded(
+            [pq], 8, hbm_budget_per_shard=budget, host_budget_per_shard=1024
+        )
+        assert tiny.feasible and tiny.tier("p", "raw_vectors") == "disk"
+        assert tiny.disk_bytes_per_shard > 0 and tiny.host_bytes_per_shard == 0
+        bad = plan_placement_sharded([pq], 8, hbm_budget_per_shard=1024)
+        assert not bad.feasible and "INFEASIBLE" in bad.table()
+
+    def test_host_budget_charged_with_staging_slabs(self):
+        """The double-buffered staging slabs compete with the raw slab
+        for host RAM: a budget that fits the slab alone but not the
+        slab + 2x staging pushes the slab to disk."""
+        pq = ivf_pq_residency(
+            "p", n_rows=100_000, dim=64, n_lists=64, pq_dim=16, pq_bits=8,
+            refine_rows=100_000,
+        )
+        req_ps = sum(c.per_shard_bytes(8) for c in pq.components if c.required)
+        budget = int(req_ps / 0.9) + (16 << 10)
+        raw_ps = pq.by_name("raw_vectors").per_shard_bytes(8)
+        sh, _ = staging_footprint(64, 4)
+        fits = plan_placement_sharded(
+            [pq], 8, hbm_budget_per_shard=budget,
+            host_budget_per_shard=raw_ps + sh,
+        )
+        assert fits.tier("p", "raw_vectors") == "host"
+        squeezed = plan_placement_sharded(
+            [pq], 8, hbm_budget_per_shard=budget,
+            host_budget_per_shard=raw_ps + sh - 1,
+        )
+        assert squeezed.tier("p", "raw_vectors") == "disk"

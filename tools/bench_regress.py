@@ -34,12 +34,19 @@ from typing import Dict, List, Optional, Tuple
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
-#: per-row metrics we understand: name -> (direction, kind)
-#: direction +1 = higher is better, -1 = lower is better
+#: per-row metrics we understand: name -> (direction, kind, tolerance)
+#: direction +1 = higher is better, -1 = lower is better; ``tolerance``
+#: names the argparse knob holding the allowed delta (fractional for
+#: "ratio" metrics, absolute for "absolute" ones)
 _METRICS = {
-    "qps": (+1, "ratio"),
-    "p99_ms": (-1, "ratio"),
-    "recall": (+1, "absolute"),
+    "qps": (+1, "ratio", "qps_drop"),
+    "p99_ms": (-1, "ratio", "p99_rise"),
+    "recall": (+1, "absolute", "recall_drop"),
+    # tiered / tiered_sharded phase columns (bench.py): host-tier fetch
+    # traffic and ICI wire traffic regress by growing, overlap by shrinking
+    "fetch_bytes_per_query": (-1, "ratio", "bytes_rise"),
+    "wire_bytes_per_query": (-1, "ratio", "bytes_rise"),
+    "overlap_efficiency": (+1, "absolute", "overlap_drop"),
 }
 
 
@@ -106,30 +113,34 @@ def _check(name: str, new: float, ref: float, ref_label: str,
            args) -> Optional[str]:
     """One metric comparison; returns a human-readable regression line
     or ``None`` when within tolerance."""
-    direction, kind = _METRICS[name]
+    direction, kind, tol_name = _METRICS[name]
+    tol = getattr(args, tol_name)
     if kind == "absolute":
         drop = ref - new if direction > 0 else new - ref
-        if drop > args.recall_drop:
+        if drop > tol:
             return (f"{name} {new:.4f} vs {ref_label} {ref:.4f} "
-                    f"(drop {drop:.4f} > {args.recall_drop:.4f})")
+                    f"(drop {drop:.4f} > {tol:.4f})")
         return None
     if direction > 0:  # qps: flag a fractional drop
         if ref <= 0:
             return None
         drop = 1.0 - new / ref
-        if drop > args.qps_drop:
+        if drop > tol:
             return (f"{name} {new:.1f} vs {ref_label} {ref:.1f} "
-                    f"(-{drop:.0%} > {args.qps_drop:.0%})")
+                    f"(-{drop:.0%} > {tol:.0%})")
         return None
-    # p99: flag a fractional rise; ignore sub-floor values (timer noise)
-    if ref < args.ms_floor and new < args.ms_floor:
+    # lower-is-better ratio (p99, byte counters): flag a fractional rise;
+    # p99 additionally ignores sub-floor values (timer noise — byte
+    # counters are deterministic, so they get no floor)
+    if name == "p99_ms" and ref < args.ms_floor and new < args.ms_floor:
         return None
     if ref <= 0:
         return None
     rise = new / ref - 1.0
-    if rise > args.p99_rise:
-        return (f"{name} {new:.3f}ms vs {ref_label} {ref:.3f}ms "
-                f"(+{rise:.0%} > {args.p99_rise:.0%})")
+    if rise > tol:
+        unit = "ms" if name == "p99_ms" else ""
+        return (f"{name} {new:.3f}{unit} vs {ref_label} {ref:.3f}{unit} "
+                f"(+{rise:.0%} > {tol:.0%})")
     return None
 
 
@@ -157,7 +168,7 @@ def compare(runs: List[dict], args) -> Tuple[List[str], int]:
                     refs.append((f"prior(r{h['n']:02d})",
                                  _metric_values(h_row)[name]))
                     break
-            direction, _ = _METRICS[name]
+            direction, _, _tol = _METRICS[name]
             hist_vals = [
                 (h["n"], _metric_values(h["rows"][key])[name])
                 for h in history
@@ -212,6 +223,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="flag p99 rises beyond this fraction (default 0.50)")
     ap.add_argument("--recall-drop", type=float, default=0.02,
                     help="flag absolute recall drops beyond this (default 0.02)")
+    ap.add_argument("--bytes-rise", type=float, default=0.50,
+                    help="flag fetch/wire bytes-per-query rises beyond this "
+                         "fraction (default 0.50)")
+    ap.add_argument("--overlap-drop", type=float, default=0.25,
+                    help="flag absolute overlap_efficiency drops beyond this "
+                         "(default 0.25)")
     ap.add_argument("--ms-floor", type=float, default=0.05,
                     help="ignore p99 deltas when both sides sit under this")
     ap.add_argument("--smoke", action="store_true",
