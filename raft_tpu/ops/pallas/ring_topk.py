@@ -52,6 +52,15 @@ Two engines share that schedule:
   (:func:`hop_merge` — the same rank-based placement proven bit-exact
   in ``cagra_search._rank_merge``, extended with the ``pos`` tie lane).
 
+:func:`scan_ring_topk` (``merge_mode="fused_ring"``) is the scan-fused
+variant of the same schedule: it takes the scan's full ``[nq,
+k·refine_ratio]`` candidate tile and folds it to the merge width INSIDE
+the ring engine (``_scan_ring_kernel`` stages the fold's winners
+directly into the ring's VMEM state; the XLA mirror ``_scan_fold``
+consumes the tile slice-wise), so the per-shard top-k never
+round-trips through HBM between the scan and the exchange. Wire bytes
+are unchanged vs ``ring_topk`` — only winners ride the ring.
+
 Failure semantics: :func:`ring_topk` fires the ``comms.ring_topk``
 fault point at trace time (the collective analog of a lost ring
 participant — same placement as the ``comms.all_gather`` seam); callers
@@ -113,7 +122,10 @@ def wire_bytes_per_query(n_shards: int, k: int, mode: str = "ring") -> float:
     of (f32, i32). ``mode="ring"``: ``n-1`` reduce-scatter hops of one
     ``nq/n``-query block at :data:`RS_ENTRY_BYTES`/candidate plus
     ``n-1`` all-gather hops at :data:`AG_ENTRY_BYTES`, amortized over
-    all ``nq`` queries."""
+    all ``nq`` queries. ``mode="fused_ring"`` moves identical wire bytes
+    to ``"ring"`` — only ``k``-wide winners ever enter the ring; the
+    fusion's saving is the per-shard ``[nq, k·refine_ratio]`` candidate
+    tile never round-tripping through HBM, not the wire."""
     n = int(n_shards)
     if n <= 1:
         return 0.0
@@ -127,7 +139,38 @@ def wire_bytes_per_query(n_shards: int, k: int, mode: str = "ring") -> float:
 # ---------------------------------------------------------------------------
 
 
-def _prep(v, i, k: int, select_min: bool, axis: str):
+def _pad_cols(key, pos, v, i, width: int, select_min: bool):
+    """Right-pad the candidate lanes to ``width`` columns with losing
+    sentinels (inf key, ``_PAD_POS`` tie-break, -1 id)."""
+    pad = ((0, 0), (0, width - key.shape[1]))
+    return (
+        jnp.pad(key, pad, constant_values=jnp.inf),
+        jnp.pad(pos, pad, constant_values=_PAD_POS),
+        jnp.pad(v, pad, constant_values=jnp.inf if select_min else -jnp.inf),
+        jnp.pad(i, pad, constant_values=-1),
+    )
+
+
+def _scan_fold(key, pos, v, i, k: int, select_min: bool):
+    """Streaming local top-k: fold the ``[nq, kc]`` candidate tile into
+    ``[nq, k]`` one ``k``-wide slice at a time through :func:`_fold`
+    instead of one monolithic width-``kc`` sort. Bit-identical to the
+    sort-truncate (the (key, pos) total order makes every fold schedule
+    associative) — this is the XLA mirror of the fused kernel's in-VMEM
+    scan fold, shaped so the wide tile is consumed slice-wise rather
+    than re-materialized sorted."""
+    kc = key.shape[1]
+    acc = (key[:, :k], pos[:, :k], v[:, :k], i[:, :k])
+    for c0 in range(k, kc, k):
+        c1 = min(c0 + k, kc)
+        sl = tuple(x[:, c0:c1] for x in (key, pos, v, i))
+        if c1 - c0 < k:
+            sl = _pad_cols(*sl, k, select_min)
+        acc = _fold(acc, sl, k)
+    return acc
+
+
+def _prep(v, i, k: int, select_min: bool, axis: str, scan_fold: bool = False):
     """Normalize local candidates to the ring's working layout.
 
     Returns ``(key, pos, v, i, n, B, nq)`` where the first four are
@@ -135,8 +178,9 @@ def _prep(v, i, k: int, select_min: bool, axis: str):
     ``-v`` for max), the global concat position tie-break, and the
     carried value/id payloads. Width is padded (losing sentinels) or
     truncated (a local 2-key top-k — entries past local rank ``k`` can
-    never enter the global top-k) to ``k``; query rows are padded to a
-    multiple of the axis size."""
+    never enter the global top-k; ``scan_fold=True`` folds slice-wise
+    via :func:`_scan_fold`, bit-identically) to ``k``; query rows are
+    padded to a multiple of the axis size."""
     n = axis_size(axis)
     r = lax.axis_index(axis)
     nq, kc = v.shape
@@ -145,8 +189,11 @@ def _prep(v, i, k: int, select_min: bool, axis: str):
     pos = (r * kc + lax.broadcasted_iota(jnp.int32, (nq, kc), 1)).astype(jnp.int32)
     key = v if select_min else -v
     if kc > k:
-        key, pos, v, i = lax.sort((key, pos, v, i), dimension=1, num_keys=2)
-        key, pos, v, i = key[:, :k], pos[:, :k], v[:, :k], i[:, :k]
+        if scan_fold:
+            key, pos, v, i = _scan_fold(key, pos, v, i, k, select_min)
+        else:
+            key, pos, v, i = lax.sort((key, pos, v, i), dimension=1, num_keys=2)
+            key, pos, v, i = key[:, :k], pos[:, :k], v[:, :k], i[:, :k]
     elif kc < k:
         pad = ((0, 0), (0, k - kc))
         key = jnp.pad(key, pad, constant_values=jnp.inf)
@@ -179,8 +226,8 @@ def _fold(a, b, w: int):
 # ---------------------------------------------------------------------------
 
 
-def _ring_topk_xla(v, i, k: int, select_min: bool, axis: str):
-    key, pos, v, i, n, B, nq = _prep(v, i, k, select_min, axis)
+def _ring_topk_xla(v, i, k: int, select_min: bool, axis: str, scan_fold: bool = False):
+    key, pos, v, i, n, B, nq = _prep(v, i, k, select_min, axis, scan_fold=scan_fold)
     r = lax.axis_index(axis)
     state = tuple(x.reshape(n, B, k) for x in (key, pos, v, i))
     if n == 1:
@@ -237,6 +284,16 @@ def kernel_scratch_shapes(n: int, B: int, w: int):
         pltpu.SemaphoreType.DMA((2, 4)),      # send sems [slot, lane]
         pltpu.SemaphoreType.DMA((2, 4)),      # recv sems [slot, lane]
     ]
+
+
+def scan_kernel_scratch_shapes(n: int, B: int, w: int, kc: int):
+    """Scratch declarations of the scan-fused ring kernel — identical to
+    :func:`kernel_scratch_shapes` (the scan fold reuses the state
+    buffers as its accumulator target; only the *input* refs widen to
+    ``kc`` columns). Exposed for the same vmem_model drift guard."""
+    expects(kc % w == 0 and kc >= w,
+            "scan width %d must be a positive multiple of merge width %d", kc, w)
+    return kernel_scratch_shapes(n, B, w)
 
 
 def _rank_merge_pos(uk, up, uv, ui, w: int):
@@ -303,37 +360,28 @@ def hop_merge(a, b, qt: int = _FOLD_ROWS, interpret: bool = True):
     )(*a, *b)
 
 
-def _ring_kernel(
-    n: int, B: int, w: int, axis: str,
-    ink, inp, inv, ini, ov, oi,
-    sk, sp, sv, si,          # state [n, B, w]
-    tk, tp, tv, ti,          # send slots [2, B, w]
-    rk, rp, rv, ri,          # recv slots [2, B, w]
-    send_sem, recv_sem,
-):
-    """The fused ring: reduce-scatter then all-gather, one
+def _ring_body(n: int, B: int, w: int, axis: str, ov, oi, state, send, recv,
+               send_sem, recv_sem):
+    """The shared ring schedule: reduce-scatter then all-gather, one
     ``make_async_remote_copy`` bundle per hop into the right neighbor's
     recv slot, fold on the VPU while the outgoing DMA drains (its
     send-semaphore wait is deferred until the slot is restaged two hops
     later — the double-buffer discipline of the guide's ring
-    all-gather)."""
+    all-gather). ``state`` must already hold the staged ``[n, B, w]``
+    per-block partials; :func:`_ring_kernel` stages a straight copy of
+    the inputs, :func:`_scan_ring_kernel` stages the scan fold's
+    winners."""
     me = lax.axis_index(axis)
     right = lax.rem(me + 1, n)
     left = lax.rem(me + n - 1, n)
 
     # neighbor rendezvous: nobody DMAs into a peer still setting up
+    # (staging touches only local state, never a recv slot, so running
+    # it before the barrier is safe — peers cannot DMA until we signal)
     barrier = pltpu.get_barrier_semaphore()
     pltpu.semaphore_signal(barrier, inc=1, device_id=(left,))
     pltpu.semaphore_signal(barrier, inc=1, device_id=(right,))
     pltpu.semaphore_wait(barrier, 2)
-
-    for b in range(n):
-        sk[b], sp[b] = ink[b * B:(b + 1) * B], inp[b * B:(b + 1) * B]
-        sv[b], si[b] = inv[b * B:(b + 1) * B], ini[b * B:(b + 1) * B]
-
-    state = (sk, sp, sv, si)
-    send = (tk, tp, tv, ti)
-    recv = (rk, rp, rv, ri)
 
     def start_hop(slot, src_block, lanes):
         """Stage ``state[src_block]`` into the send slot and launch one
@@ -416,6 +464,58 @@ def _ring_kernel(
             pltpu.semaphore_wait(send_sem[s % 2, ln], 1)
 
 
+def _ring_kernel(
+    n: int, B: int, w: int, axis: str,
+    ink, inp, inv, ini, ov, oi,
+    sk, sp, sv, si,          # state [n, B, w]
+    tk, tp, tv, ti,          # send slots [2, B, w]
+    rk, rp, rv, ri,          # recv slots [2, B, w]
+    send_sem, recv_sem,
+):
+    """Width-``w`` inputs: stage a straight copy of each query block
+    into the state buffers, then run the shared :func:`_ring_body`."""
+    for b in range(n):
+        sk[b], sp[b] = ink[b * B:(b + 1) * B], inp[b * B:(b + 1) * B]
+        sv[b], si[b] = inv[b * B:(b + 1) * B], ini[b * B:(b + 1) * B]
+    _ring_body(n, B, w, axis, ov, oi, (sk, sp, sv, si), (tk, tp, tv, ti),
+               (rk, rp, rv, ri), send_sem, recv_sem)
+
+
+def _scan_ring_kernel(
+    n: int, B: int, w: int, kc: int, axis: str,
+    ink, inp, inv, ini, ov, oi,
+    sk, sp, sv, si,          # state [n, B, w]
+    tk, tp, tv, ti,          # send slots [2, B, w]
+    rk, rp, rv, ri,          # recv slots [2, B, w]
+    send_sem, recv_sem,
+):
+    """Scan-fused staging: the inputs are the scan's FULL ``[n*B, kc]``
+    candidate tile (``kc`` a multiple of ``w``; e.g. ``k·refine_ratio``
+    wide). Each query block is folded ``w`` columns at a time through
+    :func:`_rank_merge_pos` straight into the state buffers — the local
+    top-``w`` never exists as an HBM array between the scan and the ring
+    — and the shared :func:`_ring_body` takes over. Bit-identical to
+    staging a pre-sorted top-``w``: every fold is under the (key, pos)
+    total order."""
+    state = (sk, sp, sv, si)
+    ins = (ink, inp, inv, ini)
+    for b in range(n):
+        for q0 in range(0, B, _FOLD_ROWS):
+            q1 = min(q0 + _FOLD_ROWS, B)
+            acc = tuple(x[b * B + q0:b * B + q1, 0:w] for x in ins)
+            for c0 in range(w, kc, w):
+                sl = tuple(x[b * B + q0:b * B + q1, c0:c0 + w] for x in ins)
+                uk = jnp.concatenate([acc[0], sl[0]], axis=1)
+                up = jnp.concatenate([acc[1], sl[1]], axis=1)
+                uv = jnp.concatenate([acc[2], sl[2]], axis=1)
+                ui = jnp.concatenate([acc[3], sl[3]], axis=1)
+                acc = _rank_merge_pos(uk, up, uv, ui, w)
+            for st, f in zip(state, acc):
+                pl.store(st, (pl.ds(b, 1), pl.ds(q0, q1 - q0), slice(None)), f[None])
+    _ring_body(n, B, w, axis, ov, oi, state, (tk, tp, tv, ti),
+               (rk, rp, rv, ri), send_sem, recv_sem)
+
+
 def fused_ring_topk(v, i, k: int, select_min: bool, axis: str, collective_id: int = 7):
     """Pallas async-remote-copy ring (inside ``shard_map``). Same
     schedule and (key, pos) fold as :func:`_ring_topk_xla`; real-TPU
@@ -435,6 +535,52 @@ def fused_ring_topk(v, i, k: int, select_min: bool, axis: str, collective_id: in
         compiler_params=pltpu.TPUCompilerParams(collective_id=collective_id),
     )(key, pos, vals, ii)
     # restore the inf sentinels the XLA/gather paths report
+    worst = jnp.float32(WORST if select_min else -WORST)
+    inf = jnp.float32(jnp.inf if select_min else -jnp.inf)
+    out_v = jnp.where((out_v == worst) & (out_i < 0), inf, out_v)
+    return out_v[:nq], out_i[:nq]
+
+
+def fused_scan_ring_topk(v, i, k: int, select_min: bool, axis: str,
+                         collective_id: int = 8):
+    """Scan-fused Pallas ring (inside ``shard_map``): hands the scan's
+    full ``[nq, kc]`` candidate tile to :func:`_scan_ring_kernel`, which
+    folds it to the merge width in VMEM and runs the same ring as
+    :func:`fused_ring_topk` (distinct ``collective_id`` — the two rings
+    may coexist in one program). Real-TPU only, like the plain fused
+    ring."""
+    n = axis_size(axis)
+    r = lax.axis_index(axis)
+    nq, kc = v.shape
+    if n == 1 or kc <= k:
+        # nothing to fuse: no wide local tile (or no ring at all)
+        return _ring_topk_xla(v, i, k, select_min, axis, scan_fold=True)
+    vals = v.astype(jnp.float32)
+    ids = i.astype(jnp.int32)
+    pos = (r * kc + lax.broadcasted_iota(jnp.int32, (nq, kc), 1)).astype(jnp.int32)
+    key = vals if select_min else -vals
+    w = k
+    kcp = -(-kc // w) * w
+    if kcp > kc:
+        key, pos, vals, ids = _pad_cols(key, pos, vals, ids, kcp, select_min)
+    B = -(-nq // n)
+    rpad = n * B - nq
+    if rpad:
+        pad = ((0, rpad), (0, 0))
+        key = jnp.pad(key, pad, constant_values=jnp.inf)
+        vals = jnp.pad(vals, pad, constant_values=jnp.inf if select_min else -jnp.inf)
+        pos = jnp.pad(pos, pad, constant_values=_PAD_POS)
+        ids = jnp.pad(ids, pad, constant_values=-1)
+    # in-kernel fold arithmetic needs finite sentinels (inf * 0 = NaN)
+    key = jnp.clip(key, -WORST, WORST)
+    vals = jnp.clip(vals, -WORST, WORST)
+    dts = (jnp.float32, jnp.int32)
+    out_v, out_i = pl.pallas_call(
+        functools.partial(_scan_ring_kernel, n, B, w, kcp, axis),
+        out_shape=tuple(jax.ShapeDtypeStruct((n * B, w), d) for d in dts),
+        scratch_shapes=scan_kernel_scratch_shapes(n, B, w, kcp),
+        compiler_params=pltpu.TPUCompilerParams(collective_id=collective_id),
+    )(key, pos, vals, ids)
     worst = jnp.float32(WORST if select_min else -WORST)
     inf = jnp.float32(jnp.inf if select_min else -jnp.inf)
     out_v = jnp.where((out_v == worst) & (out_i < 0), inf, out_v)
@@ -484,3 +630,49 @@ def ring_topk(
     if use_fused:
         return fused_ring_topk(v, i, k, select_min, axis)
     return _ring_topk_xla(v, i, k, select_min, axis)
+
+
+def scan_ring_topk(
+    v, i, k: int, *, select_min: bool = True, axis: str = "data",
+    use_fused: bool | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Scan-fused ring merge: like :func:`ring_topk` but takes the
+    scan's FULL ``[nq, k_candidates]`` tile (any width ≥ ``k``, ids
+    already global) and runs the local top-``k`` fold inside the ring
+    engine, so the per-shard winners never materialize in HBM between
+    the scan and the exchange (``merge_mode="fused_ring"``).
+
+    Same (value, position) total order as the gather path's stable merge
+    over the shard-major width-``k_candidates`` concatenation — the
+    global top-k is a subset of the per-shard top-k, so folding locally
+    first is bit-exact. Wire bytes are identical to ``ring_topk``; obs
+    counters land under the same ``comms.ring.*`` names and the shared
+    ``ring_topk`` span (``engine="scan_fused"/"scan_xla"``). Failures
+    escape to the caller's ``kernel_guard`` → gather fallback
+    (``fallbacks{algo="scan_ring_topk"}``)."""
+    n = axis_size(axis)
+    # same seam as ring_topk (a lost participant kills either ring);
+    # kind="scan" lets chaos drills target just the fused path
+    faults.fire("comms.ring_topk", axis=str(axis), n_shards=int(n), kind="scan")
+    if use_fused is None:
+        use_fused = jax.default_backend() == "tpu"
+
+    def run():
+        if use_fused:
+            return fused_scan_ring_topk(v, i, k, select_min, axis)
+        return _ring_topk_xla(v, i, k, select_min, axis, scan_fold=True)
+
+    if obs.is_enabled():
+        hops = 2 * max(0, n - 1)
+        B = -(-v.shape[0] // n)
+        rs = (n - 1) * B * k * RS_ENTRY_BYTES
+        ag = (n - 1) * B * k * AG_ENTRY_BYTES
+        obs.inc("comms.ring.hops", hops, axis=str(axis))
+        obs.inc("comms.ring.bytes", float(rs + ag), axis=str(axis), direction="send")
+        obs.inc("comms.ring.bytes", float(rs + ag), axis=str(axis), direction="recv")
+        with obs.span(
+            "ring_topk", axis=str(axis), n_shards=int(n), k=int(k),
+            engine="scan_fused" if use_fused else "scan_xla", traced=True,
+        ):
+            return run()
+    return run()

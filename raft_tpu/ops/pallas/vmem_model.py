@@ -394,6 +394,57 @@ def ring_topk_residency(
     return KernelResidency("ring_topk._ring_kernel", tuple(residents))
 
 
+def scan_ring_topk_residency(
+    *,
+    n: int,
+    B: int,
+    w: int,
+    kc: int,
+    fold_rows: int = 32,
+    rank_chunk: int = 64,
+) -> KernelResidency:
+    """Model ``ring_topk._scan_ring_kernel``'s residency — the
+    scan-fused ring. Relative to :func:`ring_topk_residency` only the
+    four INPUT refs widen to the scan's ``kc``-column candidate tile
+    (``kc`` a multiple of ``w``, e.g. ``k·refine_ratio``); the staging
+    fold writes straight into the same ring state, so scratch is
+    byte-identical (asserted against
+    ``ring_topk.scan_kernel_scratch_shapes``) and the body peak is the
+    same pairwise-rank chunk — the staging fold and the per-hop fold
+    share ``_rank_merge_pos`` at the same ``(fold_rows, 2w)`` union
+    shape. At kc = 2k the lint binding (n=8, B=128, w=128, kc=256)
+    totals exactly the 12 MiB (75% x 16 MiB) plan; kc = 4k (512, 16
+    MiB) breaches it — wider scans must pre-fold toward 2k upstream or
+    shrink the query block."""
+    residents = [
+        # in refs: the full scan candidate tile, kc wide
+        Resident("in_key", (n * B, kc), 4),
+        Resident("in_pos", (n * B, kc), 4),
+        Resident("in_val", (n * B, kc), 4),
+        Resident("in_id", (n * B, kc), 4),
+        Resident("out_v", (n * B, w), 4),
+        Resident("out_i", (n * B, w), 4),
+        # scratch_shapes, in declaration order (= scan_kernel_scratch_shapes)
+        Resident("state_key", (n, B, w), 4, kind="scratch"),
+        Resident("state_pos", (n, B, w), 4, kind="scratch"),
+        Resident("state_val", (n, B, w), 4, kind="scratch"),
+        Resident("state_id", (n, B, w), 4, kind="scratch"),
+        Resident("send_key", (2, B, w), 4, kind="scratch"),
+        Resident("send_pos", (2, B, w), 4, kind="scratch"),
+        Resident("send_val", (2, B, w), 4, kind="scratch"),
+        Resident("send_id", (2, B, w), 4, kind="scratch"),
+        Resident("recv_key", (2, B, w), 4, kind="scratch"),
+        Resident("recv_pos", (2, B, w), 4, kind="scratch"),
+        Resident("recv_val", (2, B, w), 4, kind="scratch"),
+        Resident("recv_id", (2, B, w), 4, kind="scratch"),
+        # peak body intermediate: less + tie of one rank chunk (shared
+        # by the staging fold and the per-hop fold)
+        Resident("rank_chunk", (fold_rows, 2 * w, rank_chunk), 4, buffers=2,
+                 kind="body"),
+    ]
+    return KernelResidency("ring_topk._scan_ring_kernel", tuple(residents))
+
+
 def ivf_scan_residency(
     *,
     m: int,

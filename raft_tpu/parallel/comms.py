@@ -83,6 +83,19 @@ _WIRE_FACTORS = {
 }
 
 
+def wire_bytes(verb: str, payload_bytes: float, n: int) -> float:
+    """Public surface of the :data:`_WIRE_FACTORS` wire model: bytes one
+    rank moves over the fabric for a ``payload_bytes`` input to ``verb``
+    on an ``n``-rank axis. This is the same model ``comms.{verb}.bytes``
+    counters apply, exposed so byte budgets elsewhere (the
+    communication-avoiding build accounting in
+    :mod:`raft_tpu.parallel.sharded_ann`, bench columns, docs tables)
+    stay pinned to one source of truth."""
+    if n <= 1:
+        return 0.0
+    return float(_WIRE_FACTORS.get(verb, lambda p, _: p)(float(payload_bytes), int(n)))
+
+
 def _instrumented(verb: str):
     """Wrap a comms verb with obs counters + a trace-time span.
 

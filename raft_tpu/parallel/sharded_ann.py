@@ -57,17 +57,21 @@ def _health_array(health, n_shards) -> jnp.ndarray:
 
 
 #: candidate-exchange engines for the lists-sharded searches
-_MERGE_MODES = ("auto", "ring", "gather")
+_MERGE_MODES = ("auto", "ring", "fused_ring", "gather")
 
 
 def _resolve_merge_mode(merge_mode: str, n_shards: int) -> str:
     """``auto`` prefers the ring exchange whenever there is more than one
     shard (parity with gather is exact, wire bytes are ~0.4n× lower); a
-    single shard has nothing to exchange and keeps the trivial path."""
+    single shard has nothing to exchange and keeps the trivial path.
+    ``fused_ring`` keeps the same wire schedule but folds the scan's
+    candidate tile to the merge width inside the ring engine."""
     expects(merge_mode in _MERGE_MODES, "merge_mode %r (want one of %s)",
             merge_mode, _MERGE_MODES)
     if merge_mode == "auto":
         return "ring" if n_shards > 1 else "gather"
+    if merge_mode == "fused_ring" and n_shards == 1:
+        return "gather"
     return merge_mode
 
 
@@ -76,17 +80,26 @@ def _exchange_merge(v, i, k, select_min, axis, merge_mode):
 
     ``ring`` streams each shard's surviving top-k around the ICI ring
     (:func:`raft_tpu.ops.pallas.ring_topk.ring_topk`), keeping wire bytes
-    and peak memory O(k) per hop; ``gather`` materialises the full
-    ``n_shards × k`` candidate set on every shard and is kept as the
-    reference engine and the ring's fallback target. Ids are bit-identical
-    between the two by the ring's (value, position) total-order contract.
+    and peak memory O(k) per hop; ``fused_ring`` hands the scan's
+    candidate tile (any width >= k) to
+    :func:`~raft_tpu.ops.pallas.ring_topk.scan_ring_topk`, which runs the
+    scan's final top-k fold inside the ring engine so the per-shard
+    ``[nq, k]`` winners never round-trip through HBM before the exchange;
+    ``gather`` materialises the full ``n_shards × k`` candidate set on
+    every shard and is kept as the reference engine and both rings'
+    fallback target. Ids are bit-identical across all three by the ring's
+    (value, position) total-order contract.
     """
+    if merge_mode == "fused_ring":
+        from raft_tpu.ops.pallas.ring_topk import scan_ring_topk  # lazy: parallel <-> ops cycle
+
+        return scan_ring_topk(v, i, k, select_min=select_min, axis=axis)
     if merge_mode == "ring":
         from raft_tpu.ops.pallas.ring_topk import ring_topk  # lazy: parallel <-> ops cycle
 
         return ring_topk(v, i, k, select_min=select_min, axis=axis)
     nq = v.shape[0]
-    all_v = jax.lax.all_gather(v, axis)  # graft-lint: ignore[gather-merge] — reference engine + ring fallback target
+    all_v = jax.lax.all_gather(v, axis)  # graft-lint: ignore[gather-merge] — reference engine + ring/fused_ring fallback target
     all_i = jax.lax.all_gather(i, axis)
     cat_v = jnp.moveaxis(all_v, 0, 1).reshape(nq, -1)
     cat_i = jnp.moveaxis(all_i, 0, 1).reshape(nq, -1)
@@ -97,17 +110,20 @@ def _exchange_merge(v, i, k, select_min, axis, merge_mode):
 def _run_with_ring_fallback(build, args, mode):
     """Execute the resolved-engine program; a failing ring program
     (injected ``comms.ring_topk`` chaos, or a real lowering/runtime error
-    on hardware) is re-run on the gather engine. The ring is purely a
+    on hardware) is re-run on the gather engine. Both rings are purely a
     transport — results are bit-identical — so falling back is always
-    safe, including for explicitly requested ``merge_mode="ring"``
-    (unlike ``mode="fused"`` kernels, where the engine *is* the request).
+    safe, including for explicitly requested ``merge_mode="ring"`` /
+    ``"fused_ring"`` (unlike ``mode="fused"`` kernels, where the engine
+    *is* the request). Fallbacks count under the existing
+    ``fallbacks{algo}`` counter with the engine's own algo label.
     """
-    if mode == "ring":
+    if mode in ("ring", "fused_ring"):
+        algo = "ring_topk" if mode == "ring" else "scan_ring_topk"
         try:
-            with kernel_guard("ring_topk"):
-                return build("ring")(*args)
+            with kernel_guard(algo):
+                return build(mode)(*args)
         except FALLBACK_ERRORS as exc:
-            record_fallback("ring_topk", exc)
+            record_fallback(algo, exc)
     return build("gather")(*args)
 
 
@@ -395,16 +411,107 @@ def sharded_ivf_pq_lists_search(
     return _run_with_ring_fallback(build, args, mode)
 
 
-def dist_lloyd_step(centers, x_local, n_lists, axis, cache=None, fuse_comms=True):
-    """One communication-avoiding distributed Lloyd iteration (runs
-    inside ``shard_map``): Flash-KMeans blocked E step on the local rows
-    (``cache`` from :func:`raft_tpu.cluster.kmeans.flash_norm_cache`,
-    hoisted across iterations), then the centroid sums and counts are
-    packed into ONE concatenated ``[n_lists, d+1]`` allreduce instead of
-    two. psum is elementwise, so the packed reduction is bit-identical
-    to the separate pair — the Lloyd trajectory is unchanged
+#: cross-shard accumulator-exchange engines for the distributed builds
+_COMM_MODES = ("auto", "full", "ca")
+
+
+def _resolve_comm_mode(comm_mode: str, n_shards: int) -> str:
+    """``auto`` prefers the communication-avoiding exchange whenever
+    there is more than one shard (wire bytes per iteration drop to the
+    changed-row fraction); a single shard pays no wire bytes either way
+    and keeps the reference ``full`` exchange."""
+    expects(comm_mode in _COMM_MODES, "comm_mode %r (want one of %s)",
+            comm_mode, _COMM_MODES)
+    if comm_mode == "auto":
+        return "ca" if n_shards > 1 else "full"
+    return comm_mode
+
+
+def _ca_cap(n_rows: int, ca_cap) -> int:
+    """Exchanged-row budget for the CA accumulator exchange. The default
+    quarter-width (floored at 8) keeps the byte model ≥ ~2× below the
+    full exchange for any row width the builds use while leaving enough
+    slack that Lloyd's churn fits within a couple of iterations (churn
+    decays geometrically after the first assignment pass)."""
+    if ca_cap is None:
+        ca_cap = min(n_rows, max(8, n_rows // 4))
+    cap = int(ca_cap)
+    expects(1 <= cap <= n_rows, "ca_cap %d outside [1, %d]", cap, n_rows)
+    return cap
+
+
+def _note_build_comms(phase: str, payload_bytes: float, axis: str,
+                      verb: str = "allreduce", launches: int = 1) -> None:
+    """Trace-time build-comms accounting: one ``comms.build.launches``
+    tick per collective launch and the wire-model bytes
+    (:func:`raft_tpu.parallel.comms.wire_bytes`) under
+    ``comms.build.bytes``, both labelled with the build ``phase``. The
+    build programs retrace per call, so per-iteration launches inside the
+    Python training loop each fire once."""
+    from raft_tpu import obs
+    from raft_tpu.parallel._compat import axis_size
+    from raft_tpu.parallel.comms import wire_bytes
+
+    if not obs.is_enabled():
+        return
+    n = axis_size(axis)
+    obs.inc("comms.build.launches", float(launches), phase=phase)
+    obs.inc("comms.build.bytes", wire_bytes(verb, payload_bytes, n), phase=phase)
+
+
+def _ca_exchange(rows_local, changed_local, gsums, cap, axis, phase):
+    """Communication-avoiding accumulator exchange (runs inside
+    ``shard_map``): allreduce the tiny per-row changed-count vector,
+    pick the ``cap`` rows with the most global churn (``lax.top_k`` on a
+    replicated input — every shard selects the same rows, ties broken by
+    lowest index), allreduce ONLY those rows' fresh local partials, and
+    patch them into the carried global accumulator.
+
+    Exactness: a row whose assignments did not change on ANY shard has a
+    bit-identical local partial this iteration (same rows, summed in the
+    same order), so its carried psum value already equals a fresh
+    full-width psum bit-for-bit. Whenever the global changed-row count
+    fits under ``cap`` every iteration, the CA trajectory is therefore
+    bit-identical to the ``full`` exchange (trivially so at
+    ``cap=n_rows``); beyond the cap the least-churned rows lag one
+    iteration — the bounded-drift regime covered by the recall-floor
+    contract. Zero-change rows drafted to fill the cap re-psum to
+    identical bits, so over-selection is harmless."""
+    from raft_tpu.parallel.comms import allreduce
+
+    gchanged = allreduce(changed_local, "sum", axis)
+    _, sel = lax.top_k(gchanged, cap)
+    block = allreduce(jnp.take(rows_local, sel, axis=0), "sum", axis)
+    _note_build_comms(
+        phase,
+        changed_local.size * 4 + block.size * 4,
+        axis,
+        launches=2,
+    )
+    return gsums.at[sel].set(block)
+
+
+def dist_lloyd_step(centers, x_local, n_lists, axis, cache=None, fuse_comms=True,
+                    comm_mode="full", carry=None, ca_cap=None):
+    """One distributed Lloyd iteration (runs inside ``shard_map``):
+    Flash-KMeans blocked E step on the local rows (``cache`` from
+    :func:`raft_tpu.cluster.kmeans.flash_norm_cache`, hoisted across
+    iterations), then the centroid sums and counts are packed into ONE
+    concatenated ``[n_lists, d+1]`` allreduce instead of two. psum is
+    elementwise, so the packed reduction is bit-identical to the
+    separate pair — the Lloyd trajectory is unchanged
     (``fuse_comms=False`` keeps the two-allreduce reference for the
-    trajectory/byte-count tests)."""
+    trajectory/byte-count tests).
+
+    ``comm_mode="ca"`` is the communication-avoiding exchange: the step
+    carries ``(prev_labels, packed_global_sums)`` across iterations and
+    each iteration moves only the ``ca_cap`` most-churned lists' partial
+    sums (plus a ``[n_lists]`` changed-count vector) over the wire — see
+    :func:`_ca_exchange` for the bit-identical-under-cap contract and
+    :func:`lloyd_wire_bytes_per_iter` for the byte model. In CA mode the
+    step returns ``(centers, labels, carry)``; pass ``carry=None`` on
+    the first iteration (which pays one full-width exchange to seed the
+    carried accumulator)."""
     from raft_tpu.cluster.kmeans import flash_min_cluster_and_distance
     from raft_tpu.parallel.comms import allreduce
 
@@ -413,22 +520,49 @@ def dist_lloyd_step(centers, x_local, n_lists, axis, cache=None, fuse_comms=True
     )
     sums = jax.ops.segment_sum(x_local, lab, num_segments=n_lists)
     cnts = jax.ops.segment_sum(jnp.ones_like(lab, jnp.float32), lab, num_segments=n_lists)
+    if comm_mode == "ca":
+        local_rows = jnp.concatenate([sums, cnts[:, None]], axis=1)
+        if carry is None:
+            packed = allreduce(local_rows, "sum", axis)
+            _note_build_comms("kmeans_full", local_rows.size * 4, axis)
+        else:
+            prev_lab, gsums = carry
+            moved = (lab != prev_lab).astype(jnp.float32)
+            changed = (
+                jax.ops.segment_sum(moved, lab, num_segments=n_lists)
+                + jax.ops.segment_sum(moved, prev_lab, num_segments=n_lists)
+            )
+            cap = _ca_cap(n_lists, ca_cap)
+            packed = _ca_exchange(local_rows, changed, gsums, cap, axis, "kmeans_ca")
+        gs, gc = packed[:, :-1], packed[:, -1]
+        new = gs / jnp.maximum(gc[:, None], 1e-9)
+        centers_out = jnp.where(gc[:, None] > 0, new, centers)
+        return centers_out, lab, (lab, packed)
     if fuse_comms:
         packed = allreduce(jnp.concatenate([sums, cnts[:, None]], axis=1), "sum", axis)
+        _note_build_comms("kmeans_full", packed.size * 4, axis)
         sums, cnts = packed[:, :-1], packed[:, -1]
     else:
         sums = allreduce(sums, "sum", axis)
         cnts = allreduce(cnts, "sum", axis)
+        _note_build_comms("kmeans_full", sums.size * 4 + cnts.size * 4, axis,
+                          launches=2)
     new = sums / jnp.maximum(cnts[:, None], 1e-9)
     return jnp.where(cnts[:, None] > 0, new, centers), lab
 
 
-def dist_codebook_step(books, resid, ksub, axis, fuse_comms=True):
+def dist_codebook_step(books, resid, ksub, axis, fuse_comms=True,
+                       comm_mode="full", carry=None, ca_cap=None):
     """One distributed per-subspace codebook update (runs inside
     ``shard_map``): local assignment of residual sub-vectors, then the
     ``[pq_dim, ksub, pq_len]`` sums and ``[pq_dim, ksub]`` counts ride
     one concatenated allreduce (counts as an extra trailing column),
-    matching :func:`dist_lloyd_step`'s comm fusion bit-for-bit."""
+    matching :func:`dist_lloyd_step`'s comm fusion bit-for-bit.
+
+    ``comm_mode="ca"`` flattens the accumulator to ``[pq_dim·ksub,
+    pq_len+1]`` rows and applies the same carried changed-rows exchange
+    as the Lloyd step (:func:`_ca_exchange`); returns ``(books, carry)``
+    with ``carry=(codes, packed_rows)``."""
     from raft_tpu.parallel.comms import allreduce
 
     dots = jnp.einsum("npl,pkl->npk", resid, books, preferred_element_type=jnp.float32)
@@ -437,14 +571,65 @@ def dist_codebook_step(books, resid, ksub, axis, fuse_comms=True):
     oh = jax.nn.one_hot(code, ksub, dtype=jnp.float32)  # [nl, pq_dim, ksub]
     sums = jnp.einsum("npk,npl->pkl", oh, resid)
     cnts = jnp.sum(oh, axis=0)  # [pq_dim, ksub]
+    if comm_mode == "ca":
+        pq_dim, _, pq_len = sums.shape
+        local_rows = jnp.concatenate([sums, cnts[..., None]], axis=-1)
+        local_rows = local_rows.reshape(pq_dim * ksub, pq_len + 1)
+        if carry is None:
+            packed = allreduce(local_rows, "sum", axis)
+            _note_build_comms("pq_codebook_full", local_rows.size * 4, axis)
+        else:
+            prev_code, grows = carry
+            moved = (code != prev_code).astype(jnp.float32)  # [nl, pq_dim]
+            prev_oh = jax.nn.one_hot(prev_code, ksub, dtype=jnp.float32)
+            changed = (
+                jnp.einsum("np,npk->pk", moved, oh)
+                + jnp.einsum("np,npk->pk", moved, prev_oh)
+            ).reshape(pq_dim * ksub)
+            cap = _ca_cap(pq_dim * ksub, ca_cap)
+            packed = _ca_exchange(local_rows, changed, grows, cap, axis, "pq_codebook_ca")
+        rows = packed.reshape(pq_dim, ksub, pq_len + 1)
+        gs, gc = rows[..., :-1], rows[..., -1]
+        new = gs / jnp.maximum(gc[..., None], 1e-9)
+        return jnp.where(gc[..., None] > 0, new, books), (code, packed)
     if fuse_comms:
         packed = allreduce(jnp.concatenate([sums, cnts[..., None]], axis=-1), "sum", axis)
+        _note_build_comms("pq_codebook_full", packed.size * 4, axis)
         sums, cnts = packed[..., :-1], packed[..., -1]
     else:
         sums = allreduce(sums, "sum", axis)
         cnts = allreduce(cnts, "sum", axis)
+        _note_build_comms("pq_codebook_full", sums.size * 4 + cnts.size * 4, axis,
+                          launches=2)
     new = sums / jnp.maximum(cnts[..., None], 1e-9)
     return jnp.where(cnts[..., None] > 0, new, books)
+
+
+def lloyd_wire_bytes_per_iter(n_lists: int, d: int, n_shards: int,
+                              comm_mode: str = "full", ca_cap=None) -> float:
+    """Wire bytes one rank moves per distributed Lloyd iteration under
+    the :func:`raft_tpu.parallel.comms.wire_bytes` model. ``full`` is the
+    fused ``[n_lists, d+1]`` f32 allreduce; ``ca`` is the steady-state
+    CA exchange — a ``[n_lists]`` changed-count allreduce plus a
+    ``[cap, d+1]`` selected-rows allreduce (the first iteration's
+    carry-seeding full exchange is excluded; it amortises to zero over
+    the training loop)."""
+    from raft_tpu.parallel.comms import wire_bytes
+
+    if comm_mode == "full":
+        return wire_bytes("allreduce", 4.0 * n_lists * (d + 1), n_shards)
+    cap = _ca_cap(n_lists, ca_cap)
+    return (wire_bytes("allreduce", 4.0 * n_lists, n_shards)
+            + wire_bytes("allreduce", 4.0 * cap * (d + 1), n_shards))
+
+
+def codebook_wire_bytes_per_iter(pq_dim: int, ksub: int, pq_len: int, n_shards: int,
+                                 comm_mode: str = "full", ca_cap=None) -> float:
+    """Wire bytes one rank moves per distributed codebook iteration —
+    the :func:`lloyd_wire_bytes_per_iter` model over the flattened
+    ``[pq_dim·ksub, pq_len+1]`` accumulator rows."""
+    return lloyd_wire_bytes_per_iter(pq_dim * ksub, pq_len, n_shards,
+                                     comm_mode=comm_mode, ca_cap=ca_cap)
 
 
 def sharded_ivf_pq_build(
@@ -453,6 +638,9 @@ def sharded_ivf_pq_build(
     params: Optional["ivf_pq_mod.IvfPqIndexParams"] = None,
     axis: str = "data",
     fuse_comms: bool = True,
+    comm_mode: str = "auto",
+    ca_cap=None,
+    ca_warmup: int = 2,
     **kwargs,
 ) -> "ivf_pq_mod.IvfPqIndex":
     """Distributed IVF-PQ build sketch (SURVEY §7 step 7): dataset rows
@@ -463,7 +651,24 @@ def sharded_ivf_pq_build(
     then every shard encodes its rows locally and the packed lists
     are assembled. The returned index is replicated (at DCN scale the
     final allgather would be skipped and the lists kept sharded for
-    :func:`sharded_ivf_pq_lists_search`)."""
+    :func:`sharded_ivf_pq_lists_search`).
+
+    ``comm_mode`` picks the per-iteration accumulator exchange:
+    ``"full"`` is the reference fused allreduce, ``"ca"`` carries the
+    global accumulator and moves only the most-churned rows each
+    iteration (:func:`_ca_exchange`; bit-identical to ``full`` while the
+    per-iteration churn fits under ``ca_cap``, recall-bounded beyond
+    it), ``"auto"`` is CA whenever sharded. ``ca_warmup`` full-width
+    Lloyd exchanges run before the capped exchange takes over —
+    assignment churn is front-loaded (it decays geometrically once the
+    centers coarse-settle), so paying full bytes for the first couple
+    of iterations recovers nearly all of the full-mode recall while the
+    steady-state per-iteration wire stays at the CA rate. Codebooks are
+    seeded from a strided sample of EVERY shard's residuals (one
+    init-only allgather) so the seed pool spans the global residual
+    distribution — the rank-0-only seed this replaces left ~0.02 recall
+    on the table vs the single-chip build whenever one shard's rows
+    couldn't cover ``ksub`` distinct seeds."""
     if params is None:
         params = ivf_pq_mod.IvfPqIndexParams(**kwargs)
     dataset = jnp.asarray(dataset, jnp.float32)
@@ -474,6 +679,7 @@ def sharded_ivf_pq_build(
     pq_dim = params.pq_dim or ivf_pq_mod._default_pq_dim(d)
     rot_dim = ((d + pq_dim - 1) // pq_dim) * pq_dim
     ksub = 1 << params.pq_bits
+    mode = _resolve_comm_mode(comm_mode, n_shards)
 
     key = as_key(params.seed)
     k_init, k_rot = jax.random.split(key)
@@ -481,34 +687,69 @@ def sharded_ivf_pq_build(
     rotation = ivf_pq_mod._make_rotation(k_rot, rot_dim, d, params.force_random_rotation)
 
     def train(x_local, centers0):
-        from raft_tpu.cluster.kmeans import flash_norm_cache
+        from raft_tpu.cluster.kmeans import flash_min_cluster_and_distance, flash_norm_cache
+        from raft_tpu.parallel.comms import allgather
 
         # sample-side norms are iteration-invariant: hoist them out of
         # the Lloyd loop (the Flash-KMeans cache discipline)
         cache = flash_norm_cache(x_local, DistanceType.L2Expanded)
         centers = centers0
-        for _ in range(params.kmeans_n_iters):
-            centers, _ = dist_lloyd_step(
+        if mode == "ca":
+            carry = None
+            for it in range(params.kmeans_n_iters):
+                if it < ca_warmup - 1:
+                    # warm-up: full-width while churn is still heavy
+                    # (the first CA call re-seeds full-width anyway, so
+                    # ca_warmup counts TOTAL full exchanges)
+                    centers, _ = dist_lloyd_step(
+                        centers, x_local, n_lists, axis, cache=cache,
+                        fuse_comms=True,
+                    )
+                    continue
+                centers, lab, carry = dist_lloyd_step(
+                    centers, x_local, n_lists, axis, cache=cache,
+                    comm_mode="ca", carry=carry, ca_cap=ca_cap,
+                )
+            # final labeling against the converged centers is comm-free
+            lab, _ = flash_min_cluster_and_distance(
+                x_local, centers, metric=DistanceType.L2Expanded, cache=cache
+            )
+        else:
+            for _ in range(params.kmeans_n_iters):
+                centers, _ = dist_lloyd_step(
+                    centers, x_local, n_lists, axis, cache=cache, fuse_comms=fuse_comms
+                )
+            _, lab = dist_lloyd_step(
                 centers, x_local, n_lists, axis, cache=cache, fuse_comms=fuse_comms
             )
-        _, lab = dist_lloyd_step(
-            centers, x_local, n_lists, axis, cache=cache, fuse_comms=fuse_comms
-        )
         # per-subspace codebooks on local residuals, psum'd updates;
-        # seeded from rank 0's first ksub residual rows (a real-data init —
-        # random gaussians collapse to few used centers)
+        # seeded from a stride-spread sample of EVERY shard's residuals
+        # (real-data init — random gaussians collapse to few used
+        # centers; a single shard's rows skew or under-fill the pool)
         resid = ((x_local - centers[lab]) @ rotation.T).reshape(x_local.shape[0], pq_dim, -1)
-        n_seed = min(ksub, resid.shape[0])
-        seed = lax.psum(
-            jnp.where(lax.axis_index(axis) == 0, 1.0, 0.0) * resid[:n_seed], axis
-        )  # [n_seed, pq_dim, pq_len]
-        books = jnp.transpose(seed, (1, 0, 2))
+        nl_local = resid.shape[0]
+        per = -(-ksub // n_shards)
+        stride = max(1, nl_local // per)
+        idx = jnp.minimum(jnp.arange(per) * stride, nl_local - 1)
+        pool = allgather(resid[idx], axis)  # [n_shards, per, pq_dim, pq_len]
+        _note_build_comms("seed", pool[0].size * 4, axis, verb="allgather")
+        seed = jnp.swapaxes(pool, 0, 1).reshape(n_shards * per, pq_dim, -1)
+        n_seed = min(ksub, n_shards * nl_local)
+        books = jnp.transpose(seed[:n_seed], (1, 0, 2))
         if n_seed < ksub:
             reps = -(-ksub // n_seed)
             books = jnp.tile(books, (1, reps, 1))[:, :ksub, :]
 
-        for _ in range(max(4, params.kmeans_n_iters)):
-            books = dist_codebook_step(books, resid, ksub, axis, fuse_comms=fuse_comms)
+        if mode == "ca":
+            bcarry = None
+            for _ in range(max(4, params.kmeans_n_iters)):
+                books, bcarry = dist_codebook_step(
+                    books, resid, ksub, axis,
+                    comm_mode="ca", carry=bcarry, ca_cap=ca_cap,
+                )
+        else:
+            for _ in range(max(4, params.kmeans_n_iters)):
+                books = dist_codebook_step(books, resid, ksub, axis, fuse_comms=fuse_comms)
         return centers, books
 
     fn = jax.jit(

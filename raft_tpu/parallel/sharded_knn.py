@@ -44,6 +44,13 @@ def _knn_fn(mesh, axis, k, metric, metric_arg, per, dataset_tile, select_min,
             has_filter=False,
         )
         idx = jnp.where(idx >= 0, idx + rank * per, idx)
+        if merge_mode == "fused_ring":
+            # scan-fused ring: the local block enters the ring engine's
+            # own fold (identical here where the block is already k wide,
+            # but keeps one engine per merge_mode across the tree)
+            from raft_tpu.ops.pallas.ring_topk import scan_ring_topk  # lazy: parallel <-> ops cycle
+
+            return scan_ring_topk(vals, idx, k, select_min=select_min, axis=axis)
         if merge_mode == "ring":
             # stream each shard's [nq, k] block around the ring instead of
             # materialising all n_shards blocks on every shard
@@ -52,7 +59,7 @@ def _knn_fn(mesh, axis, k, metric, metric_arg, per, dataset_tile, select_min,
             return ring_topk(vals, idx, k, select_min=select_min, axis=axis)
         # Gather each shard's [nq, k] block -> [n_shards, nq, k], flatten the
         # part axis into the candidate axis and merge (knn_merge_parts).
-        all_vals = jax.lax.all_gather(vals, axis)  # graft-lint: ignore[gather-merge] — reference engine + ring fallback target
+        all_vals = jax.lax.all_gather(vals, axis)  # graft-lint: ignore[gather-merge] — reference engine + ring/fused_ring fallback target
         all_idx = jax.lax.all_gather(idx, axis)
         nq = q.shape[0]
         cat_vals = jnp.moveaxis(all_vals, 0, 1).reshape(nq, -1)

@@ -47,6 +47,10 @@ _METRICS = {
     "fetch_bytes_per_query": (-1, "ratio", "bytes_rise"),
     "wire_bytes_per_query": (-1, "ratio", "bytes_rise"),
     "overlap_efficiency": (+1, "absolute", "overlap_drop"),
+    # dist_build phase columns (bench.py): per-iteration build-comms
+    # traffic regresses by growing, the full:ca reduction by shrinking
+    "wire_bytes_per_iter": (-1, "ratio", "bytes_rise"),
+    "build_bytes_ratio": (+1, "ratio", "bytes_rise"),
 }
 
 

@@ -9,10 +9,14 @@ easy to reintroduce:
   top-k/sort/merge over the concatenation. Every rank receives
   ``(n-1) x payload`` bytes and materialises the full
   ``n_shards x k`` candidate set just to throw most of it away — the
-  communication-avoiding form is ``ring_topk`` (bit-identical ids).
-  The intentional gather sites — the parity reference engine and the
-  ring's fallback target — carry a rationale'd
-  ``# graft-lint: ignore[gather-merge]``.
+  communication-avoiding form is ``ring_topk`` (bit-identical ids) —
+  or ``scan_ring_topk`` when the scan's wide candidate tile is still
+  in hand (``merge_mode="fused_ring"``: the local fold happens inside
+  the ring engine). The intentional gather sites — the parity
+  reference engine and the fallback target for BOTH ring engines
+  (``ring`` and ``fused_ring`` demote to the same gather on kernel
+  failure, so the suppressed site backs two production paths) — carry
+  a rationale'd ``# graft-lint: ignore[gather-merge]``.
 
 * ``collective-divergence`` — a collective (``psum``/``ppermute``/
   ``all_gather``/…) issued under a branch that depends on the rank
@@ -67,8 +71,9 @@ class GatherMergeChecker(Checker):
     doc = (
         "all_gather of per-shard candidate val/idx pairs followed by a "
         "top-k/sort merge — O(n_shards·k) wire and memory per rank; use "
-        "ring_topk (bit-identical ids, O(k) per hop) or suppress the "
-        "intentional gather fallback with a rationale"
+        "ring_topk / scan_ring_topk (bit-identical ids, O(k) per hop) or "
+        "suppress the intentional gather fallback — the reference engine "
+        "both ring modes demote to — with a rationale"
     )
 
     def check(self, module: LintModule) -> Iterator[Violation]:

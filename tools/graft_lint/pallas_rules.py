@@ -65,8 +65,10 @@ KERNEL_SHAPE_BINDINGS: Dict[str, Dict[str, object]] = {
     # (vmem_model.cagra_search_residency defaults)
     "cagra_search": dict(qt=32, itopk=160, width=8, deg=16, d=128),
     # the ICI ring top-k exchange at the 8-chip serving shape
-    # (vmem_model.ring_topk_residency: n devices, B block rows, w = k)
-    "ring_topk": dict(n=8, B=128, w=128, qt=32),
+    # (vmem_model.ring_topk_residency: n devices, B block rows, w = k;
+    # kc = the scan-fused variant's candidate-tile width — 2k is the
+    # widest that fits the 75% VMEM plan, see scan_ring_topk_residency)
+    "ring_topk": dict(n=8, B=128, w=128, qt=32, kc=256),
     # tools/micro_layout.py — the layout microbench kernel
     "micro_layout": dict(QT=128, D=128, M=8704, block=(1, 8704, 128)),
 }

@@ -99,6 +99,12 @@ _DISPATCH_PREFIXES = (
 #: prefixes routed to the "robustness & mutability" health table
 _HEALTH_PREFIXES = ("robust.", "mutable.", "faults.")
 
+#: distributed-build comm accounting (comms.build.bytes{phase}/
+#: comms.build.launches{phase}) — its own table so the CA-vs-full byte
+#: savings are visible per build phase, not buried among serving
+#: counters
+_BUILD_COMMS_PREFIX = "comms.build."
+
 #: serve-side metrics that belong to the health picture, not the
 #: generic serving tables (a generation flip is a mutability event the
 #: operator correlates with compactions, not with QPS)
@@ -287,9 +293,22 @@ def render_report(*paths: str, top: int = 10) -> str:
     if health_rows:
         sections.append("## robustness & mutability\n"
                         + _table(health_rows, ["metric", "kind", "value"]))
+    # distributed-build comms: per-phase collective launches and
+    # wire-model bytes (kmeans_full vs kmeans_ca vs pq_codebook_*,
+    # plus the init-only seed allgather) — the table that SHOWS the
+    # communication-avoiding savings instead of just asserting them
+    build_rows = [
+        [k, f"{v:g}"]
+        for k, v in sorted(counters.items())
+        if k.startswith(_BUILD_COMMS_PREFIX)
+    ]
+    if build_rows:
+        sections.append("## build comms\n"
+                        + _table(build_rows, ["counter", "value"]))
     plain = {k: v for k, v in counters.items()
              if not k.startswith(_HEALTH_PREFIXES + _HEALTH_EXTRAS
-                                 + _DISPATCH_PREFIXES)}
+                                 + _DISPATCH_PREFIXES
+                                 + (_BUILD_COMMS_PREFIX,))}
     if plain:
         rows = [[k, f"{v:g}"] for k, v in sorted(plain.items())]
         sections.append("## counters\n" + _table(rows, ["counter", "value"]))
