@@ -15,6 +15,7 @@ import threading
 from typing import Dict
 
 from raft_tpu.core.errors import RaftError
+from raft_tpu.utils import lockcheck
 
 
 class InterruptedException(RaftError):
@@ -22,7 +23,7 @@ class InterruptedException(RaftError):
 
 
 _tokens: Dict[int, threading.Event] = {}
-_lock = threading.Lock()
+_lock = lockcheck.tracked(threading.Lock(), "core.interruptible")
 
 
 def _token(tid: int | None = None) -> threading.Event:

@@ -28,6 +28,8 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from raft_tpu.utils import lockcheck
+
 
 def _default_device() -> jax.Device:
     return jax.devices()[0]
@@ -63,7 +65,7 @@ class Resources:
         if self.device is None:
             self.device = _default_device()
         self._key = jax.random.key(self.seed)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.tracked(threading.Lock(), "core.resources")
         self._registry: dict[str, Any] = {}
 
     # -- RNG key stream ----------------------------------------------------
@@ -114,7 +116,7 @@ class Resources:
 
 
 _default_resources: Optional[Resources] = None
-_default_lock = threading.Lock()
+_default_lock = lockcheck.tracked(threading.Lock(), "core.resources_default")
 
 
 def default_resources() -> Resources:

@@ -171,8 +171,11 @@ def compact_background(
             return new_gen
         finally:
             # on success phase 3 already cleared it; on any failure the
-            # index must stop capturing (and drop the backlog copy)
-            mut._capture = None
+            # index must stop capturing (and drop the backlog copy) —
+            # under _lock, or a writer mid-append could capture into the
+            # list an instant after this clears it
+            with mut._lock:
+                mut._capture = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -211,6 +214,7 @@ class CompactionPolicy:
         return None
 
 
+@lockcheck.guarded_fields
 class Compactor:
     """Background compaction worker for one mutable index.
 
@@ -355,15 +359,18 @@ class Compactor:
                 self._thread = None
                 restart = True
         if restart:
-            self.worker_restarts += 1
+            with self._state_lock:
+                self.worker_restarts += 1
             obs.inc("mutable.maintenance.worker_restarts", index=self.name)
             self.start()
         reason = None
         if self.policy is not None and not self.busy() and not self._stop.is_set():
+            with self._state_lock:
+                last_done = self._last_done_t
             interval_ok = (
-                self._last_done_t is None
+                last_done is None
                 or self.policy.min_interval_s <= 0
-                or self._clock() - self._last_done_t >= self.policy.min_interval_s
+                or self._clock() - last_done >= self.policy.min_interval_s
             )
             if interval_ok:
                 reason = self.policy.reason(self.mut)
@@ -376,9 +383,11 @@ class Compactor:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            self._beats += 1
+            with self._state_lock:
+                self._beats += 1
+                beats = self._beats
             obs.set_gauge(
-                "mutable.maintenance.heartbeat", float(self._beats), index=self.name
+                "mutable.maintenance.heartbeat", float(beats), index=self.name
             )
             self._wake.wait(self._poll_interval_s)
             self._wake.clear()
@@ -410,20 +419,24 @@ class Compactor:
                 obs.inc("mutable.compact.retries", index=self.name, mode="background")
             return compact_background(self.mut, res=self._res)
 
+        with self._state_lock:
+            seed = self._seed + self.completed + self.failed
         try:
             retry_call(
                 _attempt,
                 policy=self._retry_policy,
                 op="mutable.compact.background",
-                seed=self._seed + self.completed + self.failed,
+                seed=seed,
             )
-            self.completed += 1
-            self.last_error = None
-            self._last_done_t = self._clock()
+            with self._state_lock:
+                self.completed += 1
+                self.last_error = None
+                self._last_done_t = self._clock()
         except RetryError as e:
-            self.failed += 1
-            self.last_error = e.last
-            self._last_done_t = self._clock()
+            with self._state_lock:
+                self.failed += 1
+                self.last_error = e.last
+                self._last_done_t = self._clock()
             obs.inc(
                 "mutable.compact.failed", index=self.name,
                 error=type(e.last).__name__,

@@ -406,6 +406,7 @@ class Snapshot:
         return out_d, out_i
 
 
+@lockcheck.guarded_fields
 class MutableIndex:
     """A mutable, crash-consistent index over one immutable index type.
 

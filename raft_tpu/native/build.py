@@ -18,11 +18,12 @@ import threading
 from typing import Optional
 
 from raft_tpu.robust.retry import RetryError, RetryPolicy, retry_call
+from raft_tpu.utils import lockcheck
 
 _CACHE_DIR = os.path.join(
     os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "raft_tpu_native"
 )
-_LOCK = threading.Lock()
+_LOCK = lockcheck.tracked(threading.Lock(), "native.build")
 _LOADED: dict = {}
 
 #: fs/toolchain hiccups (NFS races, OOM-killed cc) are transient; a failed
@@ -47,39 +48,49 @@ def _compiler() -> Optional[str]:
 
 def load_native(name: str) -> Optional[ctypes.CDLL]:
     """Compile (once) and load ``raft_tpu/native/<name>.c``; ``None`` if no
-    compiler is available or compilation fails."""
+    compiler is available or compilation fails.
+
+    ``_LOCK`` covers only the ``_LOADED`` cache, never the compile: the
+    retry loop emits obs metrics (which take the registry lock) and the
+    compile itself blocks for seconds, so both run lock-free. Two
+    threads racing on a cold cache may both compile — each writes a
+    pid-suffixed temp and ``os.replace`` s it into place atomically, so
+    the duplicates are identical and harmless; first publisher wins the
+    cache slot."""
     with _LOCK:
         if name in _LOADED:
             return _LOADED[name]
-        src = os.path.join(os.path.dirname(__file__), f"{name}.c")
-        try:
-            with open(src, "rb") as f:
-                code = f.read()
-        except OSError:
-            _LOADED[name] = None
+    lib = _build_and_load(name)
+    with _LOCK:
+        return _LOADED.setdefault(name, lib)
+
+
+def _build_and_load(name: str) -> Optional[ctypes.CDLL]:
+    src = os.path.join(os.path.dirname(__file__), f"{name}.c")
+    try:
+        with open(src, "rb") as f:
+            code = f.read()
+    except OSError:
+        return None
+    tag = hashlib.sha256(code).hexdigest()[:16]
+    out = os.path.join(_CACHE_DIR, f"{name}-{tag}.so")
+    if not os.path.exists(out):
+        cc = _compiler()
+        if cc is None:
             return None
-        tag = hashlib.sha256(code).hexdigest()[:16]
-        out = os.path.join(_CACHE_DIR, f"{name}-{tag}.so")
-        if not os.path.exists(out):
-            cc = _compiler()
-            if cc is None:
-                _LOADED[name] = None
-                return None
-            os.makedirs(_CACHE_DIR, exist_ok=True)
-            tmp = out + f".tmp{os.getpid()}"
-            cmd = cc.split() + ["-O3", "-shared", "-fPIC", "-o", tmp, src]
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        tmp = out + f".tmp{os.getpid()}"
+        cmd = cc.split() + ["-O3", "-shared", "-fPIC", "-o", tmp, src]
 
-            def _compile():
-                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-                os.replace(tmp, out)
+        def _compile():
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, out)
 
-            try:
-                retry_call(_compile, policy=_COMPILE_RETRY, op="native.compile")
-            except RetryError:
-                _LOADED[name] = None
-                return None
         try:
-            _LOADED[name] = ctypes.CDLL(out)
-        except OSError:
-            _LOADED[name] = None
-        return _LOADED[name]
+            retry_call(_compile, policy=_COMPILE_RETRY, op="native.compile")
+        except RetryError:
+            return None
+    try:
+        return ctypes.CDLL(out)
+    except OSError:
+        return None

@@ -65,6 +65,7 @@ def _labels_key(labels: Dict[str, Any]) -> LabelsKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+@lockcheck.guarded_fields
 class Counter:
     """Monotonically increasing value (``prometheus counter`` semantics)."""
 
@@ -82,6 +83,7 @@ class Counter:
             self.value += value
 
 
+@lockcheck.guarded_fields
 class Gauge:
     """Last-write-wins value."""
 
@@ -103,6 +105,7 @@ class Gauge:
             self.value += value
 
 
+@lockcheck.guarded_fields
 class Histogram:
     """Fixed-bucket histogram: ``buckets`` are sorted upper bounds; one
     implicit +Inf bucket catches the tail. Tracks sum and count like the
@@ -162,6 +165,7 @@ class Histogram:
         ]
 
 
+@lockcheck.guarded_fields
 class Registry:
     """Thread-safe metric + span store. One process-wide default lives in
     this module (:func:`registry`); tests may construct their own."""
@@ -272,48 +276,54 @@ class Registry:
 
     def as_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+        # Every instrument shares this registry's RLock, so reading their
+        # fields here IS the writers' critical section — snapshotting the
+        # metric list and formatting off-lock would copy counts/sum/count
+        # mid-observe (torn histogram totals).
         with self._lock:
-            metrics = list(self._metrics.values())
-            n_spans = len(self._spans)
-        for m in metrics:
-            key = self._fmt_key(m.name, m.labels)
-            if m.kind == "histogram":
-                h = {
-                    "buckets": list(m.buckets),
-                    "counts": list(m.counts),
-                    "sum": m.sum,
-                    "count": m.count,
-                }
-                if m.exemplars:
-                    h["exemplars"] = m.exemplar_rows()
-                out["histograms"][key] = h
-            else:
-                out[m.kind + "s"][key] = m.value
-        out["n_spans"] = n_spans
-        out["spans_dropped"] = self.spans_dropped
+            for m in self._metrics.values():
+                key = self._fmt_key(m.name, m.labels)
+                if m.kind == "histogram":
+                    h = {
+                        "buckets": list(m.buckets),
+                        "counts": list(m.counts),
+                        "sum": m.sum,
+                        "count": m.count,
+                    }
+                    if m.exemplars:
+                        h["exemplars"] = m.exemplar_rows()
+                    out["histograms"][key] = h
+                else:
+                    out[m.kind + "s"][key] = m.value
+            out["n_spans"] = len(self._spans)
+            out["spans_dropped"] = self.spans_dropped
         return out
 
     def dump_jsonl(self, stream) -> None:
         """One JSON object per line: every metric, then every span — a
         self-contained snapshot ``tools/obs_report.py`` can summarize."""
+        recs: List[Dict[str, Any]] = []
+        # build the records under the shared instrument lock (see
+        # as_dict); only the stream writes happen off-lock
         with self._lock:
-            metrics = list(self._metrics.values())
+            for m in self._metrics.values():
+                rec: Dict[str, Any] = {
+                    "kind": m.kind,
+                    "name": m.name,
+                    "labels": dict(m.labels),
+                }
+                if m.kind == "histogram":
+                    rec.update(
+                        buckets=list(m.buckets), counts=list(m.counts),
+                        sum=m.sum, count=m.count,
+                    )
+                    if m.exemplars:
+                        rec["exemplars"] = m.exemplar_rows()
+                else:
+                    rec["value"] = m.value
+                recs.append(rec)
             spans = list(self._spans)
-        for m in metrics:
-            rec: Dict[str, Any] = {
-                "kind": m.kind,
-                "name": m.name,
-                "labels": dict(m.labels),
-            }
-            if m.kind == "histogram":
-                rec.update(
-                    buckets=list(m.buckets), counts=list(m.counts),
-                    sum=m.sum, count=m.count,
-                )
-                if m.exemplars:
-                    rec["exemplars"] = m.exemplar_rows()
-            else:
-                rec["value"] = m.value
+        for rec in recs:
             stream.write(json.dumps(rec) + "\n")
         for s in spans:
             stream.write(json.dumps({"kind": "span", **s}) + "\n")
@@ -321,33 +331,34 @@ class Registry:
     def prometheus_text(self) -> str:
         """Prometheus text exposition format (the ``/metrics`` payload)."""
         lines: List[str] = []
-        with self._lock:
-            metrics = list(self._metrics.values())
         seen_type: set = set()
-        for m in metrics:
-            pname = _prom_name(m.name)
-            if pname not in seen_type:
-                seen_type.add(pname)
-                lines.append(f"# TYPE {pname} {m.kind}")
-            if m.kind == "histogram":
-                cum = 0
-                for ub, c in zip(m.buckets, m.counts):
-                    cum += c
-                    lines.append(
-                        self._fmt_key(
-                            pname + "_bucket", m.labels + (("le", _fmt_float(ub)),)
+        # string formatting is cheap; holding the shared instrument lock
+        # across it buys consistent bucket/sum/count triples (see as_dict)
+        with self._lock:
+            for m in self._metrics.values():
+                pname = _prom_name(m.name)
+                if pname not in seen_type:
+                    seen_type.add(pname)
+                    lines.append(f"# TYPE {pname} {m.kind}")
+                if m.kind == "histogram":
+                    cum = 0
+                    for ub, c in zip(m.buckets, m.counts):
+                        cum += c
+                        lines.append(
+                            self._fmt_key(
+                                pname + "_bucket", m.labels + (("le", _fmt_float(ub)),)
+                            )
+                            + f" {cum}"
                         )
+                    cum += m.counts[-1]
+                    lines.append(
+                        self._fmt_key(pname + "_bucket", m.labels + (("le", "+Inf"),))
                         + f" {cum}"
                     )
-                cum += m.counts[-1]
-                lines.append(
-                    self._fmt_key(pname + "_bucket", m.labels + (("le", "+Inf"),))
-                    + f" {cum}"
-                )
-                lines.append(self._fmt_key(pname + "_sum", m.labels) + f" {_fmt_float(m.sum)}")
-                lines.append(self._fmt_key(pname + "_count", m.labels) + f" {m.count}")
-            else:
-                lines.append(self._fmt_key(pname, m.labels) + f" {_fmt_float(m.value)}")
+                    lines.append(self._fmt_key(pname + "_sum", m.labels) + f" {_fmt_float(m.sum)}")
+                    lines.append(self._fmt_key(pname + "_count", m.labels) + f" {m.count}")
+                else:
+                    lines.append(self._fmt_key(pname, m.labels) + f" {_fmt_float(m.value)}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:

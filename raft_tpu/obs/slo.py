@@ -95,6 +95,7 @@ class SloStatus:
         return dataclasses.asdict(self)
 
 
+@lockcheck.guarded_fields
 class SloTracker:
     """Sliding-window good/bad accounting + burn-rate alert state for
     one :class:`SLO`. Thread-safe; metric emission happens outside the

@@ -77,6 +77,7 @@ class _Flight:
     attempts: int = 1
 
 
+@lockcheck.guarded_fields
 class ReplicaGroup:
     """N replicas of a serving engine behind health-aware routing and
     re-queueing failover.
@@ -176,7 +177,8 @@ class ReplicaGroup:
         )
         for eng, idx in zip(self.engines, handles):
             eng.register_mutable(index_id, idx, **kwargs)
-        self._replications[index_id] = replication
+        with self._lock:
+            self._replications[index_id] = replication
 
     def registered(self) -> List[str]:
         return self.engines[0].registered()
@@ -237,7 +239,11 @@ class ReplicaGroup:
             if fl.deadline_s is not None:
                 remaining_ms = max((fl.deadline_s - now) * 1e3, 0.0)
             try:
-                fl.efut = self.engines[rid].submit(
+                # _Flight is single-owner: exactly one thread holds it at a
+                # time (submitter until placed, then whichever pump harvests
+                # it), with ownership handed off through _flights under
+                # self._lock — its fields never need their own guard
+                fl.efut = self.engines[rid].submit(  # graft-lint: ignore[guard-inference]
                     fl.index_id, fl.queries, fl.k,
                     deadline_ms=remaining_ms,
                     trace_id=fl.trace_id or None,
@@ -246,7 +252,7 @@ class ReplicaGroup:
                 last_exc = e
                 tried.add(rid)
                 continue
-            fl.replica = rid
+            fl.replica = rid  # graft-lint: ignore[guard-inference] — single-owner handoff, see above
             return True, None
 
     # -- the loop drivers --------------------------------------------------
@@ -262,11 +268,20 @@ class ReplicaGroup:
         for rid in range(self.n_replicas):
             done += self._pump_replica(rid, force)
         done += self._retry_parked()
-        now = self._clock()
-        if now - self._last_maint >= self.maintenance_interval_ms / 1e3:
-            self._last_maint = now
+        if self._maint_due():
             self.maintenance_tick()
         return done
+
+    def _maint_due(self) -> bool:
+        """Rate-limit gate for maintenance: check-and-advance
+        ``_last_maint`` atomically so concurrent drivers can't both fire
+        the same interval (the tick itself runs outside the lock)."""
+        now = self._clock()
+        with self._lock:
+            if now - self._last_maint >= self.maintenance_interval_ms / 1e3:
+                self._last_maint = now
+                return True
+        return False
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> int:
         """Drive :meth:`step` until no flight, parked request, or queued
@@ -403,7 +418,7 @@ class ReplicaGroup:
             ))
             return
         failed_on = fl.replica
-        fl.attempts += 1
+        fl.attempts += 1  # graft-lint: ignore[guard-inference] — single-owner handoff, see _place
         placed, _ = self._place(fl, exclude={failed_on})
         if placed:
             with self._lock:
@@ -447,7 +462,9 @@ class ReplicaGroup:
         """Drive every replication pipeline one cycle (leader seal →
         ship sealed frames → follower replay) and publish follower lag
         to the router's admission floor."""
-        for replication in list(self._replications.values()):
+        with self._lock:
+            replications = list(self._replications.values())
+        for replication in replications:
             replication.tick()
             for j in range(len(replication.followers)):
                 self.router.set_staleness(j + 1, replication.staleness(j))
@@ -525,9 +542,7 @@ class ReplicaGroup:
                 self._pump_replica(rid, force=True)
                 if rid == 0:
                     self._retry_parked()
-                    now = self._clock()
-                    if now - self._last_maint >= self.maintenance_interval_ms / 1e3:
-                        self._last_maint = now
+                    if self._maint_due():
                         self.maintenance_tick()
             except Exception as e:
                 # a pump loop must never die silently: count and keep

@@ -37,6 +37,7 @@ from raft_tpu.robust.retry import CircuitBreaker
 from raft_tpu.utils import lockcheck
 
 
+@lockcheck.guarded_fields
 class Router:
     """Least-queue-depth admission over breaker-healthy, fresh-enough
     replicas."""

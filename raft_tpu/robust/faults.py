@@ -129,6 +129,7 @@ class FaultSpec:
         return False
 
 
+@lockcheck.guarded_fields
 class FaultRegistry:
     """Thread-safe store of installed :class:`FaultSpec` s."""
 

@@ -116,6 +116,7 @@ class Request:
         return self.deadline_s is not None and now > self.deadline_s
 
 
+@lockcheck.guarded_fields
 class MicroBatcher:
     """Bounded FIFO of :class:`Request` s with flush-on-size /
     flush-on-age batching and deadline-aware admission.

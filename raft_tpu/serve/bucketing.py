@@ -129,6 +129,7 @@ class CacheStats:
         return self.misses
 
 
+@lockcheck.guarded_fields
 class ProgramCache:
     """LRU cache of dispatchable search programs keyed by
     :class:`ProgramKey`.

@@ -33,8 +33,11 @@ edges are still collected (``edges()``), nothing is flagged.
 """
 from __future__ import annotations
 
+import functools
 import os
+import sys
 import threading
+import types
 from typing import Dict, List, Optional, Set, Tuple
 
 _TRUTHY = ("1", "true", "on", "yes")
@@ -146,6 +149,15 @@ class _Manifest:
             for e in data.get("edge", [])
             if "from" in e and "to" in e
         }
+        #: class name -> (lock name, fully guarded fields, write-guarded)
+        self.guards: Dict[str, Tuple[str, Tuple[str, ...], Tuple[str, ...]]] = {}
+        for e in data.get("guards", []):
+            if "class" in e and "lock" in e:
+                self.guards[e["class"]] = (
+                    e["lock"],
+                    tuple(e.get("fields", [])),
+                    tuple(e.get("write_guarded", [])),
+                )
 
     def permits(self, held: str, acquired: str) -> bool:
         return held == acquired or (held, acquired) in self.edges
@@ -256,16 +268,191 @@ def tracked(lock, name: str):
     return TrackedLock(lock, name)
 
 
+# -- the guarded-field witness ------------------------------------------------
+#
+# The dynamic counterpart of the static guarded-field rule: under
+# RAFT_TPU_LOCKCHECK=1, @guarded_fields installs a data descriptor per
+# field the manifest's [[guards]] section declares for the class, and
+# every access asserts the declared lock is on the accessing thread's
+# held stack. Off, the decorator returns the class untouched — raw
+# attribute access, no descriptor, zero overhead.
+#
+# Semantics mirror the static rule exactly:
+#
+# * `fields` check reads and writes; `write_guarded` checks writes only
+#   (lock-free reads are the declared bounded-staleness idiom).
+# * The __init__ / fresh-object escapes become *creator-thread arming*:
+#   the wrapped __init__ records the constructing thread, and
+#   enforcement starts only once a second thread touches the instance
+#   (it is then "shared" forever). MutableIndex.open() populating a
+#   fresh instance never trips it; the known limit is that the second
+#   thread's own first racing access is the one that arms, so that
+#   single access goes unchecked.
+# * Enforcement is scoped to library frames: for a class defined under
+#   the raft_tpu package, accesses from outside the package (tests
+#   peeking at `mut.generation`) are exempt — matching the static scan
+#   scope. Classes defined outside the package (the witness's own unit
+#   tests) are enforced from everywhere.
+#
+# Coverage bookkeeping: a guard is *armed* when its class is
+# instantiated during the run, and *exercised* when any access to one
+# of its fields is observed with the declared lock held (in enforcement
+# scope). The conftest sessionfinish gate fails a witness-enabled run
+# with field violations or armed-but-unexercised guards.
+
+_PKG_PREFIX = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+_field_violations: List[str] = []
+_field_violation_keys: Set[Tuple[str, str, str, int]] = set()
+_field_exercised: Set[str] = set()
+_field_armed: Set[str] = set()
+#: id(instance) -> creating thread ident / shared flag. id() reuse after
+#: gc is handled by the wrapped __init__, which re-registers and clears
+#: the shared flag before any field of the new instance can be touched.
+_instance_owner: Dict[int, int] = {}
+_shared_instances: Set[int] = set()
+
+
+class _GuardedField:
+    """Data descriptor asserting the declared lock on field access.
+    Dict-backed classes store the value in the instance ``__dict__``
+    under the field's own name (the descriptor wins attribute lookup
+    because it defines ``__set__``); ``__slots__`` classes delegate to
+    the captured member descriptor."""
+
+    __slots__ = ("cls_name", "field", "lock_name", "write_only",
+                 "member", "everywhere")
+
+    def __init__(self, cls_name, field, lock_name, write_only, member, everywhere):
+        self.cls_name = cls_name
+        self.field = field
+        self.lock_name = lock_name
+        self.write_only = write_only
+        self.member = member
+        self.everywhere = everywhere
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        if not self.write_only:
+            self._check(obj, "read")
+        if self.member is not None:
+            return self.member.__get__(obj, objtype)
+        try:
+            return obj.__dict__[self.field]
+        except KeyError:
+            raise AttributeError(
+                f"{self.cls_name!r} object has no attribute {self.field!r}"
+            ) from None
+
+    def __set__(self, obj, value):
+        self._check(obj, "write")
+        if self.member is not None:
+            self.member.__set__(obj, value)
+        else:
+            obj.__dict__[self.field] = value
+
+    def __delete__(self, obj):
+        self._check(obj, "write")
+        if self.member is not None:
+            self.member.__delete__(obj)
+        else:
+            del obj.__dict__[self.field]
+
+    def _check(self, obj, kind: str) -> None:
+        frame = sys._getframe(2)
+        if not self.everywhere and not frame.f_code.co_filename.startswith(
+            _PKG_PREFIX
+        ):
+            return  # test/tool code peeking at library state: out of scope
+        oid = id(obj)
+        shared = oid in _shared_instances
+        if not shared:
+            owner = _instance_owner.get(oid)
+            if owner is not None and owner != threading.get_ident():
+                _shared_instances.add(oid)
+                shared = True
+        if self.lock_name in _held_stack():
+            with _agg:
+                _field_exercised.add(self.cls_name)
+            return
+        if not shared:
+            return  # still owned by its creating thread: construction phase
+        key = (self.cls_name, self.field,
+               frame.f_code.co_filename, frame.f_lineno)
+        with _agg:
+            if key not in _field_violation_keys:
+                _field_violation_keys.add(key)
+                _field_violations.append(
+                    f"{kind} of {self.cls_name}.{self.field} at "
+                    f"{frame.f_code.co_filename}:{frame.f_lineno} without "
+                    f"{self.lock_name!r} held (thread "
+                    f"{threading.current_thread().name!r})"
+                )
+
+
+def guarded_fields(cls):
+    """Class decorator wiring the manifest's ``[[guards]]`` entry for
+    ``cls.__name__`` into runtime assertions. Returns the class
+    untouched when the witness is off at class-definition time, when no
+    manifest is found, or when the manifest declares nothing for the
+    class — so stacking it on every guarded class is free in
+    production."""
+    if not _enabled:
+        return cls
+    man = manifest()
+    if man is None:
+        return cls
+    g = man.guards.get(cls.__name__)
+    if g is None:
+        return cls
+    lock_name, fields, write_guarded = g
+    mod = sys.modules.get(cls.__module__)
+    cls_file = getattr(mod, "__file__", "") or ""
+    everywhere = not os.path.abspath(cls_file).startswith(_PKG_PREFIX)
+    for field, write_only in (
+        [(f, False) for f in fields] + [(f, True) for f in write_guarded]
+    ):
+        existing = cls.__dict__.get(field)
+        member = (
+            existing
+            if isinstance(existing, types.MemberDescriptorType)
+            else None
+        )
+        setattr(cls, field, _GuardedField(
+            cls.__name__, field, lock_name, write_only, member, everywhere,
+        ))
+
+    orig_init = cls.__init__
+
+    @functools.wraps(orig_init)
+    def _armed_init(self, *args, **kwargs):
+        oid = id(self)
+        _instance_owner[oid] = threading.get_ident()
+        _shared_instances.discard(oid)  # id reuse: this object is fresh
+        with _agg:
+            _field_armed.add(cls.__name__)
+        orig_init(self, *args, **kwargs)
+
+    cls.__init__ = _armed_init
+    return cls
+
+
 # -- reporting ---------------------------------------------------------------
 
 
 def reset() -> None:
-    """Clear recorded edges and violations (held stacks are per-thread
-    and self-balancing; they are not touched)."""
+    """Clear recorded edges, violations, and field-witness aggregates
+    (held stacks are per-thread and self-balancing; per-instance owner
+    bookkeeping survives — instances outlive a reset)."""
     with _agg:
         _edges.clear()
         _violations.clear()
         _violation_keys.clear()
+        _field_violations.clear()
+        _field_violation_keys.clear()
+        _field_exercised.clear()
+        _field_armed.clear()
 
 
 def edges() -> Dict[Tuple[str, str], int]:
@@ -289,3 +476,29 @@ def coverage() -> Tuple[Set[Tuple[str, str]], Set[Tuple[str, str]]]:
     with _agg:
         exercised = declared & set(_edges)
     return exercised, declared
+
+
+def field_violations() -> List[str]:
+    """Guarded-field accesses observed on a shared instance without the
+    declared lock held (one entry per distinct access site)."""
+    with _agg:
+        return list(_field_violations)
+
+
+def field_coverage() -> Dict[str, Dict[str, bool]]:
+    """Per declared guard class: whether the run *armed* it (constructed
+    an instance) and *exercised* it (observed a guarded access with the
+    declared lock held). ``armed and not exercised`` is a guard the run
+    never demonstrated — the sessionfinish gate fails on it. The dict is
+    JSON-ready for ``graft-lint --graph --coverage``."""
+    man = manifest()
+    declared = set(man.guards) if man is not None else set()
+    with _agg:
+        out = {
+            cls: {
+                "armed": cls in _field_armed,
+                "exercised": cls in _field_exercised,
+            }
+            for cls in sorted(declared | _field_armed | _field_exercised)
+        }
+    return out

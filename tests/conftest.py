@@ -52,16 +52,39 @@ def pytest_sessionfinish(session, exitstatus):
     (``RAFT_TPU_LOCKCHECK=1 pytest tests/test_mutable.py tests/test_serve.py``),
     any manifest-violating acquisition order observed *anywhere* in the
     run fails the session — the chaos suites double as dynamic
-    validation of ``tools/graft_lint/lock_order.toml``."""
+    validation of ``tools/graft_lint/lock_order.toml``. The same gate
+    covers the guarded-field witness: a [[guards]] field touched on a
+    shared instance without its declared lock fails the run, and so
+    does a guard whose class was instantiated (armed) but whose lock
+    was never once observed held at a guarded access (unexercised —
+    a declaration the run cannot vouch for)."""
     from raft_tpu.utils import lockcheck
 
-    if lockcheck.is_enabled() and lockcheck.violations():
+    if not lockcheck.is_enabled():
+        return
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+
+    def _fail(header, lines):
         session.exitstatus = 1
-        tr = session.config.pluginmanager.get_plugin("terminalreporter")
         if tr is not None:
-            tr.write_line("lock-witness violations:", red=True)
-            for v in lockcheck.violations():
-                tr.write_line("  " + v, red=True)
+            tr.write_line(header, red=True)
+            for line in lines:
+                tr.write_line("  " + line, red=True)
+
+    if lockcheck.violations():
+        _fail("lock-witness violations:", lockcheck.violations())
+    if lockcheck.field_violations():
+        _fail("guarded-field witness violations:", lockcheck.field_violations())
+    unexercised = [
+        cls for cls, st in lockcheck.field_coverage().items()
+        if st["armed"] and not st["exercised"]
+    ]
+    if unexercised:
+        _fail(
+            "guards armed but never exercised (no guarded access observed "
+            "with the declared lock held):",
+            unexercised,
+        )
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,38 @@
+"""Seeded violation: an unannotated shared field the inference mode
+should propose a guard for.
+
+``Ticker.beats`` is written by the spawned worker thread
+(``threading.Thread(target=self._run)``) and read from the main entry
+surface (``snapshot``), but no ``[[guards]]`` entry covers ``Ticker`` —
+new threaded code must be annotated, not grandfathered. The thread
+itself is lifecycle-correct (daemon'd, joined in ``stop``), so only the
+inference rule fires.
+
+Expected: exactly one ``guard-inference`` violation on the marked line.
+"""
+import threading
+
+
+class Ticker:
+    def __init__(self):
+        self.beats = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.beats += 1  # LINT-HERE
+            self._stop.wait(0.01)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+
+
+def snapshot(t: Ticker) -> int:
+    return t.beats

@@ -1,0 +1,23 @@
+"""Seeded violation: guarded-field access without the declared lock.
+
+``lock_order.toml`` declares ``Compactor._pending`` guarded by
+``compactor.state`` (attribute ``_state_lock``). ``request`` takes the
+lock; ``peek_unlocked`` writes the field bare — a data race with the
+worker thread flipping the same flag under the lock.
+
+Expected: exactly one ``guarded-field`` violation on the marked line.
+"""
+import threading
+
+
+class Compactor:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._pending = False  # own-__init__: recognized escape
+
+    def request(self):
+        with self._state_lock:
+            self._pending = True
+
+    def peek_unlocked(self):
+        self._pending = False  # LINT-HERE

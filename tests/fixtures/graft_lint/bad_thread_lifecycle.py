@@ -1,0 +1,17 @@
+"""Seeded violation: a thread constructed without ``daemon=True``.
+
+A non-daemon worker blocks interpreter exit if it wedges — every
+``threading.Thread(...)`` in the tree must set the flag (and be joined
+on the owning object's stop path when stored on one; this one is
+function-scoped, so the daemon flag is the whole requirement).
+
+Expected: exactly one ``thread-lifecycle`` violation on the marked line.
+"""
+import threading
+
+
+def run_worker(fn):
+    t = threading.Thread(target=fn)  # LINT-HERE
+    t.start()
+    t.join()
+    return t

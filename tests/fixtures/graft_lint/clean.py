@@ -238,3 +238,68 @@ FAULT_POINTS = (
     "wal.append",
     "manifest.swap",
 )
+
+
+# --- guarded-field / guard-inference / thread-lifecycle negative space ---
+import threading  # noqa: E402  (grouped with the section it serves)
+
+
+class Compactor:
+    """Every ``Compactor._pending`` touch here is covered: own-__init__,
+    lock held directly, lock proven held at every call site (entry-held
+    analysis), and a write_guarded atomic-reference read. The class name
+    deliberately matches the manifest [[guards]] entry so the clean
+    fixture exercises the rule's escapes, not its absence."""
+
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._pending = False  # own-__init__: pre-publication escape
+        self._thread = None
+
+    def request(self):
+        with self._state_lock:
+            self._pending = True
+
+    def _note_pending_locked(self):
+        # bare write, but the entry-held fixpoint proves the only call
+        # site already holds compactor.state
+        self._pending = True
+
+    def drive(self):
+        with self._state_lock:
+            self._note_pending_locked()
+
+    @property
+    def running(self):
+        # write_guarded field: a lock-free *read* of the atomic
+        # reference is the sanctioned snapshot idiom
+        return self._thread is not None
+
+
+def fresh_compactor():
+    # fresh-object escape: not yet visible to any other thread
+    c = Compactor()
+    c._pending = True
+    return c
+
+
+class CleanWorker:
+    """thread-lifecycle positive: daemon'd thread, joined on the stop
+    path of the owning object."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()

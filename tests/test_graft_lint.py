@@ -41,6 +41,9 @@ BAD = {
     "bad_metric_drift.py": "metric-drift",
     "bad_fault_point_drift.py": "fault-point-drift",
     "bad_orphan_span.py": "orphan-span",
+    "bad_guarded_field.py": "guarded-field",
+    "bad_guard_inference.py": "guard-inference",
+    "bad_thread_lifecycle.py": "thread-lifecycle",
 }
 
 
@@ -140,8 +143,14 @@ def test_cli_exit_codes_and_output(capsys):
 def test_cli_json_and_list_rules(capsys):
     assert lint_main(["--json", FIXTURES]) == 1
     payload = json.loads(capsys.readouterr().out)
-    assert len(payload) == len(BAD)
-    assert {"rule", "path", "line", "col", "message"} <= set(payload[0])
+    # --json reports suppressed findings too (flagged, not hidden):
+    # suppressed.py carries exactly one rationale'd ignore.
+    live = [v for v in payload if not v["suppressed"]]
+    muted = [v for v in payload if v["suppressed"]]
+    assert len(live) == len(BAD)
+    assert len(muted) == 1 and muted[0]["rule"] == "traced-branch"
+    assert {"rule", "path", "line", "col", "message", "witness",
+            "suppressed"} <= set(payload[0])
 
     assert lint_main(["--list-rules"]) == 0
     listing = capsys.readouterr().out

@@ -4,14 +4,26 @@ Runs the full checker set over ``raft_tpu/`` (plus ``bench.py`` and
 ``tools/``) and fails listing every unsuppressed violation. Known-safe
 patterns carry inline ``# graft-lint: ignore[rule-id]`` suppressions at
 the offending line (see docs/static_analysis.md).
+
+The expensive part of a lint run is building the whole-program project
+(parsing every file, indexing symbols, deriving the call graph); the
+gate builds it ONCE (session fixture) and every rule-family pass below
+reuses it — interprocedural fact caches included.
 """
 import json
 import os
+import time
 
-from tools.graft_lint import run_lint
-from tools.graft_lint.core import LintModule, iter_python_files
+from tools.graft_lint.core import (
+    LintModule,
+    iter_python_files,
+    lint_project,
+    load_project,
+)
 from tools.graft_lint.jax_rules import iter_jitted_functions
 from tools.graft_lint.pallas_rules import collect_specs
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TARGETS = [
@@ -21,23 +33,47 @@ TARGETS = [
 ]
 
 
-def test_repo_is_lint_clean():
-    violations = run_lint(TARGETS)
+@pytest.fixture(scope="module")
+def project():
+    """One shared LintProject for every gate test in this module."""
+    t0 = time.perf_counter()
+    proj = load_project(TARGETS)
+    proj.gate_build_seconds = time.perf_counter() - t0
+    return proj
+
+
+def test_repo_is_lint_clean(project, capsys):
+    t0 = time.perf_counter()
+    violations = lint_project(project)
+    gate_s = time.perf_counter() - t0
     assert not violations, (
         f"graft-lint found {len(violations)} violation(s) — fix them or "
         "add an inline `# graft-lint: ignore[rule-id]` with a rationale "
         "comment:\n" + "\n".join(v.render() for v in violations)
     )
+    # The gate's wall-clock is part of its contract: one shared project
+    # build plus the full rule set must stay interactive — a slow gate
+    # stops being run. Printed with -s / on failure; asserted loosely so
+    # CI boxes of very different speeds don't flake.
+    with capsys.disabled():
+        print(
+            f"\n[graft-lint gate] project build "
+            f"{project.gate_build_seconds:.2f}s + full rule set {gate_s:.2f}s "
+            f"over {len(project.modules)} modules"
+        )
+    assert gate_s < 60.0, f"full-rule gate took {gate_s:.1f}s"
 
 
-def test_new_rules_run_strict_and_clean():
+def test_new_rules_run_strict_and_clean(project):
     """The interprocedural rules run over the repo with no exclusions
-    and report nothing — the codebase obeys its own lock-order manifest,
+    and report nothing — the codebase obeys its own lock-order manifest
+    and [[guards]] declarations, spawns only lifecycle-correct threads,
     issues no rank-divergent collectives, and keeps docs in sync with
     the emitted metric/fault-point namespaces."""
-    strict = run_lint(TARGETS, select=[
+    strict = lint_project(project, select=[
         "lock-order", "collective-divergence",
         "metric-drift", "fault-point-drift", "orphan-span",
+        "guarded-field", "guard-inference", "thread-lifecycle",
     ])
     assert not strict, "\n".join(v.render() for v in strict)
 
@@ -63,10 +99,61 @@ def test_blocking_under_lock_suppressions_pinned():
     assert all("compact.py" in w for w in where), where
 
 
+def test_guard_rule_suppressions_pinned():
+    """Every guarded-field/guard-inference hit was triaged fix-or-
+    rationale; the only rationale'd survivors are the three
+    single-owner-handoff writes on ``_Flight`` in ``replica/group.py``
+    (ownership of a flight moves between threads through ``_flights``
+    under the group lock — a happens-before edge the per-field rule
+    cannot see). ``guarded-field`` and ``thread-lifecycle`` carry ZERO
+    suppressions repo-wide: races get fixed, threads get daemon'd and
+    joined."""
+    by_rule = {"guarded-field": [], "guard-inference": [], "thread-lifecycle": []}
+    for path in iter_python_files([os.path.join(REPO, "raft_tpu")]):
+        with open(path, "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                for rule in by_rule:
+                    if f"graft-lint: ignore[{rule}]" in line:
+                        by_rule[rule].append(f"{path}:{i}")
+    assert by_rule["guarded-field"] == [], by_rule["guarded-field"]
+    assert by_rule["thread-lifecycle"] == [], by_rule["thread-lifecycle"]
+    assert len(by_rule["guard-inference"]) == 3, by_rule["guard-inference"]
+    assert all("replica/group.py" in w for w in by_rule["guard-inference"]), (
+        by_rule["guard-inference"]
+    )
+
+
+def test_json_findings_are_machine_consumable(capsys):
+    """``graft-lint --json`` is the CI hand-off format: every finding —
+    including suppressed ones, flagged rather than hidden — with rule
+    id, location, call-path witness, and suppression state. The replica
+    package carries exactly the three rationale'd guard-inference
+    suppressions, each with an interprocedural witness, and exits 0
+    because nothing unsuppressed remains."""
+    from tools.graft_lint.__main__ import main as lint_main
+
+    assert lint_main(["--json", os.path.join(REPO, "raft_tpu", "replica")]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert all(
+        {"rule", "path", "line", "col", "message", "witness", "suppressed"}
+        <= set(v) for v in payload
+    )
+    muted = [v for v in payload if v["suppressed"]]
+    assert [v["rule"] for v in muted] == ["guard-inference"] * 3
+    assert all(v["path"].endswith("replica/group.py") for v in muted)
+    # each suppressed finding names the spawned-thread-reachable writer
+    # that justified the proposal — the triage trail is machine-readable
+    assert all(
+        v["witness"] and v["witness"][0].startswith("raft_tpu.replica.group.")
+        for v in muted
+    )
+    assert not [v for v in payload if not v["suppressed"]]
+
+
 def test_graph_dump_shape_and_facts(capsys):
     """``--graph`` dumps the derived interprocedural view: call edges,
-    the lock manifest, per-function acquisition facts, and zero static
-    lock-order violations over the tree it models."""
+    the lock manifest, per-function acquisition facts, guard coverage,
+    and zero static lock-order violations over the tree it models."""
     from tools.graft_lint.__main__ import main as lint_main
 
     assert lint_main(["--graph", os.path.join(REPO, "raft_tpu", "mutable")]) == 0
@@ -80,6 +167,12 @@ def test_graph_dump_shape_and_facts(capsys):
     # the facts see through calls: _compact_once acquires the index lock
     acq = lo["acquires"]["raft_tpu.mutable.compact._compact_once"]
     assert "mutable.lock" in acq and "line" in acq["mutable.lock"]
+    # guard-coverage table: declared vs statically-verified (runtime
+    # column joins in when a witness coverage file is passed)
+    cov = {row["class"]: row for row in dump["guard_coverage"]}
+    for cls in ("MutableIndex", "Compactor"):
+        assert cov[cls]["statically_verified"], cov[cls]
+        assert cov[cls]["static_unseen_fields"] == [], cov[cls]
 
 
 def test_gate_is_not_vacuous():

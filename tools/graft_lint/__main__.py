@@ -2,21 +2,80 @@
 
 Exit status: 0 = clean, 1 = violations found, 2 = usage error.
 
+``--json`` emits machine-readable findings — rule, path, line, col,
+message, the call-path witness for interprocedural findings, and the
+suppression state (suppressed findings are *included* with their flag
+set, so the repo gate can pin the suppression count).
+
 ``--graph`` skips linting and instead dumps the interprocedural view
 the rules run on — the derived lock-acquisition edges (with one call
-path witnessing each) and a call-graph summary — as JSON, for
-debugging a surprising lock-order or blocking-under-lock finding.
+path witnessing each), a call-graph summary, and the guard-coverage
+table (declared vs statically-verified vs runtime-exercised; pass
+``--coverage FILE`` with a ``lockcheck.field_coverage()`` JSON dump to
+fill the runtime column) — for debugging a surprising finding.
+
+``--infer-guards`` runs only the guard-inference rule and prints a
+ready-to-edit ``[[guards]]`` stanza per flagged class.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 
 from tools.graft_lint.core import all_checkers, load_project, run_lint
 
 
-def _graph_dump(paths) -> dict:
+def _guard_coverage(project, manifest, coverage: dict) -> list:
+    """One row per [[guards]] declaration: declared field counts, the
+    static verification verdict, and (when a runtime coverage dump was
+    supplied) whether the witness armed and exercised the guard."""
+    from tools.graft_lint.guard_rules import static_guard_status
+
+    status = static_guard_status(project, manifest)
+    rows = []
+    for g in manifest.guards:
+        declared = tuple(g.fields) + tuple(g.write_guarded)
+        unheld = [f for f in declared if status[(g.cls, f)]["unheld"]]
+        unseen = [f for f in declared if not status[(g.cls, f)]["accesses"]]
+        runtime = coverage.get(g.cls)
+        rows.append({
+            "class": g.cls,
+            "lock": g.lock,
+            "fields": list(g.fields),
+            "write_guarded": list(g.write_guarded),
+            "statically_verified": not unheld,
+            "static_unproven_fields": sorted(unheld),
+            "static_unseen_fields": sorted(unseen),
+            "runtime": runtime,  # {"armed": bool, "exercised": bool} or None
+        })
+    return rows
+
+
+def _infer_guards(paths) -> int:
+    """Proposal mode: run only guard-inference and print a skeleton
+    [[guards]] stanza per flagged class (lock left for the author)."""
+    violations = run_lint(paths, select=["guard-inference"])
+    by_class: dict = {}
+    for v in violations:
+        m = re.search(r"'(\w+)\.(\w+)'", v.message)
+        if m:
+            by_class.setdefault(m.group(1), []).append((m.group(2), v))
+    for v in violations:
+        print(v.render())
+    for cls in sorted(by_class):
+        fields = sorted({f for f, _ in by_class[cls]})
+        print()
+        print("# proposed — pick the guarding lock and paste into lock_order.toml")
+        print("[[guards]]")
+        print(f'class = "{cls}"')
+        print('lock = "<canonical lock name>"')
+        print(f'fields = {json.dumps(fields)}')
+    return 1 if violations else 0
+
+
+def _graph_dump(paths, coverage_path=None) -> dict:
     """The derived graphs as a JSON-ready dict: every resolved call
     edge, and every lock-acquisition fact (function -> lock it may
     acquire, with the call path that witnesses it)."""
@@ -63,6 +122,11 @@ def _graph_dump(paths) -> dict:
             "acquires": locks,
             "violations": derived,
         }
+        coverage = {}
+        if coverage_path:
+            with open(coverage_path, "r", encoding="utf-8") as f:
+                coverage = json.load(f)
+        out["guard_coverage"] = _guard_coverage(project, manifest, coverage)
     return out
 
 
@@ -91,8 +155,18 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--graph", action="store_true",
-        help="dump the derived call graph and lock-order facts as JSON "
-             "instead of linting",
+        help="dump the derived call graph, lock-order facts, and the "
+             "guard-coverage table as JSON instead of linting",
+    )
+    parser.add_argument(
+        "--coverage", metavar="FILE",
+        help="lockcheck field_coverage() JSON dump filling the runtime "
+             "column of the --graph guard-coverage table",
+    )
+    parser.add_argument(
+        "--infer-guards", action="store_true",
+        help="run only the guard-inference rule and print proposed "
+             "[[guards]] stanzas for unannotated shared fields",
     )
     args = parser.parse_args(argv)
 
@@ -102,26 +176,32 @@ def main(argv=None) -> int:
         return 0
 
     if args.graph:
-        print(json.dumps(_graph_dump(args.paths), indent=2, sort_keys=True))
+        print(json.dumps(
+            _graph_dump(args.paths, args.coverage), indent=2, sort_keys=True
+        ))
         return 0
+
+    if args.infer_guards:
+        return _infer_guards(args.paths)
 
     try:
         violations = run_lint(
             args.paths,
             select=args.select.split(",") if args.select else None,
             ignore=args.ignore.split(",") if args.ignore else None,
+            include_suppressed=args.json,
         )
     except ValueError as e:
         print(f"graft-lint: {e}", file=sys.stderr)
         return 2
 
     if args.json:
-        print(json.dumps([v.__dict__ for v in violations], indent=2))
-    else:
-        for v in violations:
-            print(v.render())
-        if violations:
-            print(f"graft-lint: {len(violations)} violation(s)")
+        print(json.dumps([v.as_json() for v in violations], indent=2))
+        return 1 if any(not v.suppressed for v in violations) else 0
+    for v in violations:
+        print(v.render())
+    if violations:
+        print(f"graft-lint: {len(violations)} violation(s)")
     return 1 if violations else 0
 
 

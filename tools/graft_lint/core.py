@@ -44,16 +44,35 @@ _SKIP_FILE_RE = re.compile(r"#\s*graft-lint\s*:\s*skip-file")
 
 @dataclasses.dataclass(frozen=True)
 class Violation:
-    """One finding: ``path:line:col: rule-id message``."""
+    """One finding: ``path:line:col: rule-id message``.
+
+    ``witness`` is the call-path evidence for interprocedural findings
+    (the function quals the analysis walked through); ``suppressed`` is
+    set only when a finding matched an inline suppression and the caller
+    asked to see suppressed findings anyway (``--json`` does, so the
+    repo gate can pin the suppression count)."""
 
     rule: str
     path: str
     line: int
     col: int
     message: str
+    witness: Tuple[str, ...] = ()
+    suppressed: bool = False
 
     def render(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def as_json(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "witness": list(self.witness),
+            "suppressed": self.suppressed,
+        }
 
 
 class Checker:
@@ -633,6 +652,7 @@ def all_checkers() -> List[Checker]:
     from tools.graft_lint import (
         comms_rules,
         concurrency_rules,
+        guard_rules,
         jax_rules,
         pallas_rules,
         registry_rules,
@@ -645,6 +665,7 @@ def all_checkers() -> List[Checker]:
         *robust_rules.CHECKERS,
         *comms_rules.CHECKERS,
         *concurrency_rules.CHECKERS,
+        *guard_rules.CHECKERS,
         *registry_rules.CHECKERS,
     ]
 
@@ -668,13 +689,56 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
 
 
 def _check_module(
-    module: LintModule, checkers: Optional[Iterable[Checker]]
+    module: LintModule,
+    checkers: Optional[Iterable[Checker]],
+    include_suppressed: bool = False,
 ) -> List[Violation]:
     out: List[Violation] = []
     for checker in checkers if checkers is not None else all_checkers():
         for v in checker.check(module):
             if not module.suppressed(v):
                 out.append(v)
+            elif include_suppressed:
+                out.append(dataclasses.replace(v, suppressed=True))
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
+def select_checkers(
+    select: Optional[Sequence[str]] = None,
+    ignore: Optional[Sequence[str]] = None,
+) -> List[Checker]:
+    """The default checker set filtered by rule id; unknown ids in
+    ``select`` raise (a typo'd gate must fail loudly)."""
+    checkers = all_checkers()
+    if select:
+        wanted = set(select)
+        unknown = wanted - {c.rule for c in checkers}
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+        checkers = [c for c in checkers if c.rule in wanted]
+    if ignore:
+        checkers = [c for c in checkers if c.rule not in set(ignore)]
+    return checkers
+
+
+def lint_project(
+    project: "LintProject",
+    select: Optional[Sequence[str]] = None,
+    ignore: Optional[Sequence[str]] = None,
+    include_suppressed: bool = False,
+) -> List[Violation]:
+    """Lint an already-built whole-program project. This is the repo
+    gate's entry point: the expensive part of a lint run is building the
+    project (parsing every file, indexing symbols), so the gate builds
+    it once and runs each rule family's strict pass over the same
+    project — interprocedural fact caches carry over too."""
+    checkers = select_checkers(select, ignore)
+    out: List[Violation] = []
+    for module in project.modules:
+        if module.skip_file:
+            continue
+        out.extend(_check_module(module, checkers, include_suppressed))
     out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return out
 
@@ -723,19 +787,13 @@ def run_lint(
     paths: Sequence[str],
     select: Optional[Sequence[str]] = None,
     ignore: Optional[Sequence[str]] = None,
+    include_suppressed: bool = False,
 ) -> List[Violation]:
     """Lint files/directories as one whole-program project; returns
     unsuppressed violations sorted by location. ``select``/``ignore``
-    filter by rule id."""
-    checkers = all_checkers()
-    if select:
-        wanted = set(select)
-        unknown = wanted - {c.rule for c in checkers}
-        if unknown:
-            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
-        checkers = [c for c in checkers if c.rule in wanted]
-    if ignore:
-        checkers = [c for c in checkers if c.rule not in set(ignore)]
+    filter by rule id; ``include_suppressed`` keeps suppressed findings
+    in the output with their flag set (machine consumers)."""
+    checkers = select_checkers(select, ignore)
     out: List[Violation] = []
     modules: List[LintModule] = []
     for path in iter_python_files(paths):
@@ -758,6 +816,6 @@ def run_lint(
     for module in modules:
         if module.skip_file:
             continue
-        out.extend(_check_module(module, checkers))
+        out.extend(_check_module(module, checkers, include_suppressed))
     out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return out

@@ -1,0 +1,549 @@
+"""Guarded-field checkers: the data-race side of the lock manifest.
+
+The lock-*order* rule (:mod:`tools.graft_lint.concurrency_rules`) says
+nothing about the more common race — a shared field read or written
+with *no* lock held at all. These rules close that gap, Clang
+``GUARDED_BY`` style, driven by the ``[[guards]]`` section of
+``lock_order.toml`` (see :class:`tools.graft_lint.lockmanifest.GuardDecl`):
+
+* ``guarded-field``: every access to a declared field — ``self.x`` or
+  through a typed receiver (``mut._capture`` where ``mut:
+  MutableIndex``) — must be reachable only with the declared lock held.
+  Held-lock sets come from the same ``with``-block tracking the
+  lock-order rule uses, *plus* an interprocedural entry-held
+  must-analysis: a helper whose every (non-fresh) call site holds the
+  lock is proven, so ``MutableIndex._apply`` needs no redundant
+  re-acquisition. ``write_guarded`` fields check writes only — their
+  reads are GIL-atomic single-reference snapshots (the
+  bounded-staleness idiom).
+
+* ``guard-inference``: proposes guards for *unannotated* fields that
+  are demonstrably shared — written outside construction by code
+  reachable from a spawned thread root (``threading.Thread(target=...)``
+  sites: the Compactor worker, replica pumps) and also touched from the
+  main-thread entry surface. New threaded code gets annotated rather
+  than grandfathered.
+
+* ``thread-lifecycle``: every ``threading.Thread(...)`` construction
+  must set ``daemon=True`` (a wedged worker must never block
+  interpreter exit), and a thread stored on ``self`` must have a
+  reachable ``join()`` somewhere on its owning class (the stop/shutdown
+  path) — the Compactor and ReplicaGroup pumps are the positive
+  examples.
+
+Recognized guarded-field escapes (never reported):
+
+* accesses inside the owning class's own ``__init__`` — the instance
+  is not published yet;
+* accesses on a *freshly constructed* local instance (``self =
+  cls(...)`` in ``MutableIndex.open``, ``mut = MutableIndex(...)`` in a
+  helper) — no other thread can hold a reference;
+* snapshot-copy-then-act-outside-lock needs no escape: the rule checks
+  field *accesses*, and the copy is taken under the lock.
+
+Known limits (documented in docs/static_analysis.md): the entry-held
+analysis intersects over *resolved* call sites only — a helper also
+reachable through an unresolved callback keeps its proven set
+(optimistic); receivers the type inferencer cannot resolve (loop
+variables over heterogeneous dicts) are not checked — the runtime field
+witness (:mod:`raft_tpu.utils.lockcheck`) closes that gap dynamically.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from tools.graft_lint import lockmanifest
+from tools.graft_lint.core import (
+    Checker,
+    FunctionInfo,
+    LintModule,
+    LintProject,
+    Violation,
+    walk_executed,
+)
+from tools.graft_lint.concurrency_rules import resolve_lock
+
+
+@dataclasses.dataclass
+class FieldAccess:
+    """One attribute access on a project-class receiver."""
+
+    cls_name: str                 # receiver class name ("MutableIndex")
+    cls_qual: str                 # receiver class qual
+    field: str
+    kind: str                     # "load" | "store"
+    line: int
+    col: int
+    func: str                     # enclosing function qual
+    held: FrozenSet[str]          # locks lexically held at the access
+    fresh: bool                   # receiver is a locally constructed instance
+    in_own_init: bool             # inside the receiver class's __init__
+
+
+@dataclasses.dataclass
+class GuardFacts:
+    """Project-wide field-access and held-lock facts, computed once."""
+
+    accesses: List[FieldAccess]
+    #: callee qual -> [(caller qual, held-at-site, fresh-receiver)]
+    callsites: Dict[str, List[Tuple[str, FrozenSet[str], bool]]]
+    #: resolved threading.Thread targets: qual -> [(module path, line)]
+    thread_targets: Dict[str, List[Tuple[str, int]]]
+    #: function qual -> locks provably held on EVERY entry
+    entry_held: Dict[str, FrozenSet[str]]
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "Thread"
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "Thread"
+    return False
+
+
+def _thread_target_qual(
+    project: LintProject, info: FunctionInfo, call: ast.Call
+) -> Optional[str]:
+    """Resolve the ``target=`` callable of a Thread construction."""
+    for kw in call.keywords:
+        if kw.arg != "target":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Attribute):
+            recv = project.infer_type(info, v.value)
+            if recv is not None:
+                return project._lookup_method(recv, v.attr)
+        if isinstance(v, ast.Name):
+            r = project._resolve_export(info.module.module_name, v.id)
+            if r is not None and r[0] == "func":
+                return r[1]
+    return None
+
+
+def _fresh_locals(
+    project: LintProject, manifest: "lockmanifest.LockManifest", info: FunctionInfo
+) -> Dict[str, str]:
+    """Local names bound to a freshly constructed instance of a project
+    class: ``name -> class qual``. Covers ``self = cls(...)`` inside a
+    classmethod constructor (``cls`` builds ``info.cls``)."""
+    out: Dict[str, str] = {}
+    mod = info.module.module_name
+    for node in ast.walk(info.node):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+        ):
+            name = node.targets[0].id
+            fn = node.value.func
+            if isinstance(fn, ast.Name) and fn.id == "cls" and info.cls is not None:
+                out[name] = f"{mod}.{info.cls}"
+                continue
+            cls = project._resolve_value_class(info, fn)
+            if cls is not None:
+                out[name] = cls
+    return out
+
+
+def guard_facts(
+    project: LintProject, manifest: "lockmanifest.LockManifest"
+) -> GuardFacts:
+    """Compute (and cache on the project) every class-field access with
+    its lexically held lock set, every resolved call site with its held
+    set, the thread-root set, and the entry-held fixpoint."""
+    key = ("guard_facts", manifest.path)
+    if key in project._fact_cache:
+        return project._fact_cache[key]
+
+    accesses: List[FieldAccess] = []
+    callsites: Dict[str, List[Tuple[str, FrozenSet[str], bool]]] = {}
+    thread_targets: Dict[str, List[Tuple[str, int]]] = {}
+
+    for qual, info in project.functions.items():
+        fresh = _fresh_locals(project, manifest, info)
+        _scan_body(
+            project, manifest, info, info.node.body, (),
+            fresh, accesses, callsites, thread_targets,
+        )
+
+    entry_held = _entry_fixpoint(project, callsites, thread_targets)
+    facts = GuardFacts(accesses, callsites, thread_targets, entry_held)
+    project._fact_cache[key] = facts
+    return facts
+
+
+def _scan_body(
+    project, manifest, info, stmts, held, fresh,
+    accesses, callsites, thread_targets,
+) -> None:
+    """Stack walk of a statement list carrying the lexically held lock
+    set; recurses into ``with`` bodies with the acquired locks added."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in node.items:
+                decl = resolve_lock(
+                    project, manifest, info.module, info, item.context_expr
+                )
+                if decl is not None:
+                    new_held.append(decl.name)
+            _scan_body(
+                project, manifest, info, node.body, tuple(new_held),
+                fresh, accesses, callsites, thread_targets,
+            )
+            continue
+        if isinstance(node, ast.Attribute):
+            _record_access(project, info, node, held, fresh, accesses)
+        elif isinstance(node, ast.Call):
+            if _is_thread_ctor(node):
+                tq = _thread_target_qual(project, info, node)
+                if tq is not None:
+                    thread_targets.setdefault(tq, []).append(
+                        (info.module.path, node.lineno)
+                    )
+            target = project.resolve_call(info, node)
+            if target is not None:
+                recv_fresh = False
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+                    recv_fresh = fn.value.id in fresh
+                callsites.setdefault(target, []).append(
+                    (info.qual, frozenset(held), recv_fresh)
+                )
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _record_access(project, info, node, held, fresh, accesses) -> None:
+    base = node.value
+    cls_qual = None
+    is_fresh = False
+    if isinstance(base, ast.Name) and base.id in fresh:
+        cls_qual = fresh[base.id]
+        is_fresh = True
+    else:
+        cls_qual = project.infer_type(info, base)
+    if cls_qual is None or cls_qual not in project.classes:
+        return
+    cls_name = cls_qual.rsplit(".", 1)[-1]
+    kind = "store" if isinstance(node.ctx, (ast.Store, ast.Del)) else "load"
+    accesses.append(
+        FieldAccess(
+            cls_name=cls_name,
+            cls_qual=cls_qual,
+            field=node.attr,
+            kind=kind,
+            line=node.lineno,
+            col=node.col_offset + 1,
+            func=info.qual,
+            held=frozenset(held),
+            fresh=is_fresh,
+            in_own_init=(
+                info.cls is not None
+                and info.node.name == "__init__"
+                and f"{info.module.module_name}.{info.cls}" == cls_qual
+                and isinstance(base, ast.Name)
+                and base.id == "self"
+            ),
+        )
+    )
+
+
+def _entry_fixpoint(project, callsites, thread_targets) -> Dict[str, FrozenSet[str]]:
+    """Locks provably held on every entry to each function: the
+    intersection over non-fresh call sites of (held at the site ∪ the
+    caller's own entry set). Thread targets and functions with no
+    resolved call sites start from the empty set (anyone may call them
+    with nothing held); the fixpoint only ever shrinks, so it
+    converges."""
+    universe: FrozenSet[str] = frozenset(
+        n for sites in callsites.values() for (_, held, _) in sites for n in held
+    )
+    entry: Dict[str, FrozenSet[str]] = {}
+    for qual in project.functions:
+        sites = [s for s in callsites.get(qual, []) if not s[2]]
+        if not sites or qual in thread_targets:
+            entry[qual] = frozenset()
+        else:
+            entry[qual] = universe
+    changed = True
+    while changed:
+        changed = False
+        for qual in project.functions:
+            if not entry[qual]:
+                continue
+            sites = [s for s in callsites.get(qual, []) if not s[2]]
+            if not sites or qual in thread_targets:
+                new = frozenset()
+            else:
+                new = entry[qual]
+                for (caller, held, _) in sites:
+                    new = new & (held | entry.get(caller, frozenset()))
+            if new != entry[qual]:
+                entry[qual] = new
+                changed = True
+    return entry
+
+
+class GuardedFieldChecker(Checker):
+    rule = "guarded-field"
+    doc = (
+        "access to a lock_order.toml [[guards]] field reachable without "
+        "the declared guard held (through the call graph) — a data race; "
+        "hold the lock, or declare the idiom"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        manifest = lockmanifest.load_manifest()
+        if manifest is None or not manifest.guards:
+            return
+        project = module.project
+        if project is None:
+            return
+        facts = guard_facts(project, manifest)
+        seen: Set[Tuple[int, str, str]] = set()
+        for acc in facts.accesses:
+            fi = project.functions.get(acc.func)
+            if fi is None or fi.module is not module:
+                continue
+            g = manifest.guard_for(acc.cls_name, acc.field)
+            if g is None:
+                continue
+            decl, mode = g
+            if mode == "write" and acc.kind == "load":
+                continue
+            if acc.fresh or acc.in_own_init:
+                continue
+            effective = acc.held | facts.entry_held.get(acc.func, frozenset())
+            if decl.lock in effective:
+                continue
+            key = (acc.line, acc.cls_name, acc.field)
+            if key in seen:
+                continue
+            seen.add(key)
+            verb = "write to" if acc.kind == "store" else "read of"
+            yield Violation(
+                rule=self.rule, path=module.path, line=acc.line, col=acc.col,
+                message=(
+                    f"{verb} '{acc.cls_name}.{acc.field}' without "
+                    f"'{decl.lock}' held (guarded by lock_order.toml "
+                    f"[[guards]]; reached via {acc.func}) — hold the lock, "
+                    "or move the access into construction, or suppress "
+                    "with a rationale"
+                ),
+                witness=(acc.func,),
+            )
+
+
+#: attribute-value constructors that mark a field as synchronization
+#: machinery rather than shared data (never an inference candidate)
+_SYNC_CTORS = (
+    "Lock", "RLock", "Event", "Condition", "Semaphore",
+    "BoundedSemaphore", "Barrier", "tracked", "local",
+)
+
+
+class GuardInferenceChecker(Checker):
+    rule = "guard-inference"
+    doc = (
+        "unannotated class field written outside construction by code "
+        "reachable from a spawned thread root and touched from the main "
+        "entry surface — propose a [[guards]] entry (or suppress with "
+        "the lock-free rationale)"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        manifest = lockmanifest.load_manifest()
+        if manifest is None:
+            return
+        project = module.project
+        if project is None:
+            return
+        facts = guard_facts(project, manifest)
+        if not facts.thread_targets:
+            return
+        spawned_reach = self._reach(project, set(facts.thread_targets))
+        entries = {
+            q for q in project.functions
+            if not facts.callsites.get(q) and q not in facts.thread_targets
+        }
+        main_reach = self._reach(project, entries)
+        # spawned root(s) that reach each function, for the message
+        root_of: Dict[str, Set[str]] = {}
+        for root in facts.thread_targets:
+            for q in self._reach(project, {root}):
+                root_of.setdefault(q, set()).add(root)
+
+        by_field: Dict[Tuple[str, str], List[FieldAccess]] = {}
+        for acc in facts.accesses:
+            by_field.setdefault((acc.cls_qual, acc.field), []).append(acc)
+
+        for (cls_qual, field), accs in sorted(by_field.items()):
+            ci = project.classes.get(cls_qual)
+            if ci is None or ci.module is not module:
+                continue
+            if manifest.guarded_class(ci.name) is not None:
+                continue  # annotated class: guarded-field owns it
+            if self._is_sync_field(ci, field):
+                continue
+            writes = [
+                a for a in accs
+                if a.kind == "store" and not a.fresh and not a.in_own_init
+            ]
+            hot = [a for a in writes if a.func in spawned_reach]
+            if not hot:
+                continue
+            touched_main = any(a.func in main_reach for a in accs)
+            roots = set()
+            for a in accs:
+                roots |= root_of.get(a.func, set())
+            n_roots = len(roots) + (1 if touched_main else 0)
+            if n_roots < 2:
+                continue
+            a = min(hot, key=lambda x: x.line)
+            yield Violation(
+                rule=self.rule, path=module.path, line=a.line, col=a.col,
+                message=(
+                    f"'{ci.name}.{field}' is written outside construction "
+                    f"from a spawned thread root ({sorted(roots)[0]}) and "
+                    "touched from the main entry surface, but no "
+                    "[[guards]] entry covers it — declare its guard in "
+                    "lock_order.toml, or suppress with the lock-free "
+                    "rationale"
+                ),
+                witness=(a.func,),
+            )
+
+    @staticmethod
+    def _reach(project: LintProject, roots: Set[str]) -> Set[str]:
+        seen = set(roots)
+        frontier = list(roots)
+        while frontier:
+            q = frontier.pop()
+            for _, target in project.calls_of(q):
+                if target is not None and target not in seen:
+                    seen.add(target)
+                    frontier.append(target)
+        return seen
+
+    @staticmethod
+    def _is_sync_field(ci, field: str) -> bool:
+        expr = ci.attr_types.get(field)
+        if expr is None:
+            return False
+        name = None
+        while isinstance(expr, ast.Call):
+            expr = expr.func
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        return name in _SYNC_CTORS
+
+
+class ThreadLifecycleChecker(Checker):
+    rule = "thread-lifecycle"
+    doc = (
+        "threading.Thread constructed without daemon=True, or stored on "
+        "an object whose class never join()s it — a wedged or leaked "
+        "worker; set the daemon flag and join on the stop/shutdown path"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        project = module.project
+        for info in (project.functions.values() if project else []):
+            if info.module is not module:
+                continue
+            for node in walk_executed(info.node.body):
+                if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                    continue
+                daemon = None
+                for kw in node.keywords:
+                    if kw.arg == "daemon":
+                        daemon = kw.value
+                if not (
+                    isinstance(daemon, ast.Constant) and daemon.value is True
+                ):
+                    yield Violation(
+                        rule=self.rule, path=module.path, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            "threading.Thread(...) without daemon=True — a "
+                            "wedged worker blocks interpreter exit; mark it "
+                            "daemon AND join it on the shutdown path"
+                        ),
+                    )
+                    continue
+                if info.cls is not None and not self._class_joins(
+                    project, info
+                ):
+                    yield Violation(
+                        rule=self.rule, path=module.path, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"thread constructed in {info.cls}.{info.node.name} "
+                            f"but no method of {info.cls} ever join()s it — "
+                            "add a stop()/shutdown() that joins the worker"
+                        ),
+                    )
+
+    @staticmethod
+    def _class_joins(project: LintProject, info: FunctionInfo) -> bool:
+        ci = project._mod_classes.get(info.module.module_name, {}).get(info.cls)
+        if ci is None:
+            return False
+        for mq in ci.methods.values():
+            fi = project.functions.get(mq)
+            if fi is None:
+                continue
+            for node in walk_executed(fi.node.body):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                ):
+                    return True
+        return False
+
+
+def static_guard_status(
+    project: LintProject, manifest: "lockmanifest.LockManifest"
+) -> Dict[Tuple[str, str], Dict[str, int]]:
+    """Per declared guarded field: how many checkable accesses the
+    static analysis saw and how many of those it could NOT prove hold
+    the guard (escapes and exempt write_guarded reads excluded). The
+    ``--graph`` coverage table is built from this: a field is
+    statically verified when it has accesses and zero unproven ones;
+    zero accesses means the analysis never saw the field at all (a
+    declaration typo, or access patterns beyond the type inferencer) —
+    only the runtime witness covers it then."""
+    facts = guard_facts(project, manifest)
+    out: Dict[Tuple[str, str], Dict[str, int]] = {}
+    for g in manifest.guards:
+        for f in tuple(g.fields) + tuple(g.write_guarded):
+            out[(g.cls, f)] = {"accesses": 0, "unheld": 0}
+    for acc in facts.accesses:
+        gm = manifest.guard_for(acc.cls_name, acc.field)
+        if gm is None:
+            continue
+        decl, mode = gm
+        if mode == "write" and acc.kind == "load":
+            continue
+        if acc.fresh or acc.in_own_init:
+            continue
+        st = out.setdefault((acc.cls_name, acc.field), {"accesses": 0, "unheld": 0})
+        st["accesses"] += 1
+        effective = acc.held | facts.entry_held.get(acc.func, frozenset())
+        if decl.lock not in effective:
+            st["unheld"] += 1
+    return out
+
+
+CHECKERS = [GuardedFieldChecker(), GuardInferenceChecker(), ThreadLifecycleChecker()]

@@ -111,6 +111,26 @@ class LockDecl:
     may_block: bool = False       # holders are expected to block
 
 
+@dataclasses.dataclass(frozen=True)
+class GuardDecl:
+    """One ``[[guards]]`` entry: which fields of a class a lock guards.
+
+    ``fields`` are fully guarded — every read and write must hold the
+    lock. ``write_guarded`` fields serialize *writers* under the lock
+    but allow lock-free reads: single reference/int fields whose reads
+    are atomic under the GIL and whose readers tolerate one-write-stale
+    values (the bounded-staleness snapshot idiom — e.g. a replication
+    tick reading ``leader.wal``). Both the static ``guarded-field`` rule
+    and the runtime field witness enforce exactly these semantics."""
+
+    cls: str                      # owning class name, e.g. "MutableIndex"
+    lock: str                     # guarding lock's canonical name
+    fields: Tuple[str, ...]       # reads AND writes require the lock
+    write_guarded: Tuple[str, ...] = ()  # only writes require the lock
+    where: Tuple[str, ...] = ()   # path prefixes (doc/debug aid)
+    why: str = ""
+
+
 class LockManifest:
     """Parsed ``lock_order.toml``: lock declarations, the permitted
     acquisition-edge set, and the blocking allow-list."""
@@ -135,9 +155,24 @@ class LockManifest:
             (e["lock"], e["callee"], e.get("why", ""))
             for e in data.get("allow_blocking", [])
         ]
+        self.guards: List[GuardDecl] = []
+        for entry in data.get("guards", []):
+            self.guards.append(
+                GuardDecl(
+                    cls=entry["class"],
+                    lock=entry["lock"],
+                    fields=tuple(entry.get("fields", [])),
+                    write_guarded=tuple(entry.get("write_guarded", [])),
+                    where=tuple(entry.get("where", [])),
+                    why=entry.get("why", ""),
+                )
+            )
         self._by_attr: Dict[str, List[LockDecl]] = {}
         for decl in self.locks.values():
             self._by_attr.setdefault(decl.attr, []).append(decl)
+        self._guards_by_class: Dict[str, GuardDecl] = {
+            g.cls: g for g in self.guards
+        }
 
     @classmethod
     def load(cls, path: str = DEFAULT_MANIFEST_PATH) -> "LockManifest":
@@ -175,6 +210,24 @@ class LockManifest:
         if len(cands) == 1:
             return cands[0]
         return None
+
+    def guard_for(
+        self, class_name: str, field: str
+    ) -> Optional[Tuple[GuardDecl, str]]:
+        """The guard declaration covering ``class_name.field`` and its
+        mode (``"full"`` — reads and writes need the lock — or
+        ``"write"`` — writes only). None when the field is unguarded."""
+        g = self._guards_by_class.get(class_name)
+        if g is None:
+            return None
+        if field in g.fields:
+            return (g, "full")
+        if field in g.write_guarded:
+            return (g, "write")
+        return None
+
+    def guarded_class(self, class_name: str) -> Optional[GuardDecl]:
+        return self._guards_by_class.get(class_name)
 
     def in_scanned_scope(self, path: str) -> bool:
         norm = path.replace(os.sep, "/")
