@@ -900,11 +900,20 @@ def _search_dispatch(
 
     requested_mode = mode
     if mode == "auto":
-        mode = (
-            "fused"
-            if jax.default_backend() == "tpu" and fused_eligible(index, params, prefilter)
-            else "xla"
-        )
+        from raft_tpu import plan as _plan
+
+        on_tpu = jax.default_backend() == "tpu"
+        if _plan.is_enabled():
+            mode = _plan.plan_cagra_mode(
+                queries.shape[0], on_tpu=on_tpu,
+                fused_ok=fused_eligible(index, params, prefilter),
+            ).choice
+        else:
+            mode = (
+                "fused"
+                if on_tpu and fused_eligible(index, params, prefilter)
+                else "xla"
+            )
     expects(mode in ("xla", "fused"), "mode must be auto|xla|fused, got %r", mode)
     if mode == "fused":
         expects(

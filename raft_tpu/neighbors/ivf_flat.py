@@ -673,13 +673,16 @@ def search(
     filter_bits = prefilter.bits if prefilter is not None else None
 
     if mode == "auto":
+        from raft_tpu import plan as _plan
         from raft_tpu.ops.pallas.ivf_scan import supported_metric
 
-        if (
-            nq >= 128
-            and jax.default_backend() == "tpu"
-            and supported_metric(index.metric)
-        ):
+        on_tpu = jax.default_backend() == "tpu"
+        if _plan.is_enabled():
+            mode = _plan.plan_search_mode(
+                "ivf_flat", nq, on_tpu=on_tpu,
+                fused_ok=supported_metric(index.metric),
+            ).choice
+        elif nq >= 128 and on_tpu and supported_metric(index.metric):
             mode = "fused"
         else:
             mode = "scan" if nq >= 128 else "probe"

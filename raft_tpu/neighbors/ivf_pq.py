@@ -631,7 +631,15 @@ def build(
     )
     pq_kind = params.pq_kind
     if pq_kind == "auto":  # default: nibble whenever representable
-        if params.pq_bits == 1:
+        from raft_tpu import plan as _plan
+
+        if _plan.is_enabled():
+            pq_kind = _plan.plan_pq_kind(
+                params.pq_bits,
+                params.codebook_kind == PER_SUBSPACE,
+                pq_dim=int(getattr(params, "pq_dim", 0) or 16),
+            ).choice
+        elif params.pq_bits == 1:
             pq_kind = "rabitq"
         else:
             pq_kind = (
@@ -1672,7 +1680,15 @@ def _search_dispatch(
     )
     requested_mode = mode
     if mode == "auto":
-        if nq >= 128 and jax.default_backend() == "tpu" and fused_ok and not wants_f32_lut:
+        from raft_tpu import plan as _plan
+
+        on_tpu = jax.default_backend() == "tpu"
+        if _plan.is_enabled():
+            mode = _plan.plan_search_mode(
+                "ivf_pq", nq, on_tpu=on_tpu, fused_ok=fused_ok,
+                wants_f32_lut=wants_f32_lut,
+            ).choice
+        elif nq >= 128 and on_tpu and fused_ok and not wants_f32_lut:
             mode = "fused"
         else:
             mode = "scan" if nq >= 128 else "probe"
@@ -1897,7 +1913,14 @@ def _rabitq_modes(
     )
     requested_mode = mode
     if mode == "auto":
-        if nq >= 128 and jax.default_backend() == "tpu" and fused_ok:
+        from raft_tpu import plan as _plan
+
+        on_tpu = jax.default_backend() == "tpu"
+        if _plan.is_enabled():
+            mode = _plan.plan_search_mode(
+                "ivf_pq", nq, on_tpu=on_tpu, fused_ok=fused_ok,
+            ).choice
+        elif nq >= 128 and on_tpu and fused_ok:
             mode = "fused"
         else:
             mode = "scan" if nq >= 128 else "probe"

@@ -109,29 +109,15 @@ _FOLD_ROWS = 32
 #: (``cagra_search._RANK_CHUNK``).
 _RANK_CHUNK = 64
 
-#: Wire cost per candidate: reduce-scatter hops carry (f32 val, i32 id,
-#: i32 pos); all-gather hops carry (val, id) only.
-RS_ENTRY_BYTES = 12
-AG_ENTRY_BYTES = 8
-
-
-def wire_bytes_per_query(n_shards: int, k: int, mode: str = "ring") -> float:
-    """Estimated per-rank ICI bytes received per query for one merge.
-
-    ``mode="gather"``: each rank receives ``n-1`` foreign ``[k]`` blocks
-    of (f32, i32). ``mode="ring"``: ``n-1`` reduce-scatter hops of one
-    ``nq/n``-query block at :data:`RS_ENTRY_BYTES`/candidate plus
-    ``n-1`` all-gather hops at :data:`AG_ENTRY_BYTES`, amortized over
-    all ``nq`` queries. ``mode="fused_ring"`` moves identical wire bytes
-    to ``"ring"`` — only ``k``-wide winners ever enter the ring; the
-    fusion's saving is the per-shard ``[nq, k·refine_ratio]`` candidate
-    tile never round-tripping through HBM, not the wire."""
-    n = int(n_shards)
-    if n <= 1:
-        return 0.0
-    if mode == "gather":
-        return float((n - 1) * k * AG_ENTRY_BYTES)
-    return float((n - 1) * k * (RS_ENTRY_BYTES + AG_ENTRY_BYTES)) / n
+# The per-query merge wire model moved to the consolidated
+# raft_tpu.parallel.wire_model (the planner prices ring-vs-gather from
+# it); re-exported at this original home, where the engines' byte
+# counters and every pre-planner consumer import it from.
+from raft_tpu.parallel.wire_model import (  # noqa: F401  (re-export)
+    AG_ENTRY_BYTES,
+    RS_ENTRY_BYTES,
+    wire_bytes_per_query,
+)
 
 
 # ---------------------------------------------------------------------------

@@ -59,41 +59,15 @@ def _payload_bytes(x) -> float:
     return float(total)
 
 
-#: Per-verb wire models: bytes a rank actually moves over the fabric for
-#: an input payload of ``p`` bytes on an ``n``-rank axis, assuming XLA's
-#: ring schedules. The allgather family RECEIVES every other rank's block
-#: ((n-1)·p — NOT the p the old accounting charged, and not the n·p the
-#: stacked output shape would suggest); ring allreduce is reduce-scatter
-#: + all-gather (2p(n-1)/n); reducescatter keeps only the scatter half.
-#: Permutation verbs ship one block per rank regardless of n.
-_WIRE_FACTORS = {
-    "allreduce": lambda p, n: 2.0 * p * (n - 1) / n,
-    "reduce": lambda p, n: 2.0 * p * (n - 1) / n,
-    "barrier": lambda p, n: 2.0 * p * (n - 1) / n,
-    "reducescatter": lambda p, n: p * (n - 1) / n,
-    "allgather": lambda p, n: p * (n - 1),
-    "bcast": lambda p, n: p * (n - 1),
-    "gather": lambda p, n: p * (n - 1),
-    "gatherv": lambda p, n: p * (n - 1),
-    "scatter": lambda p, n: p * (n - 1),
-    "multicast_sendrecv": lambda p, n: p * (n - 1),
-    "ppermute": lambda p, n: p,
-    "send_recv": lambda p, n: p,
-    "device_sendrecv": lambda p, n: p,
-}
-
-
-def wire_bytes(verb: str, payload_bytes: float, n: int) -> float:
-    """Public surface of the :data:`_WIRE_FACTORS` wire model: bytes one
-    rank moves over the fabric for a ``payload_bytes`` input to ``verb``
-    on an ``n``-rank axis. This is the same model ``comms.{verb}.bytes``
-    counters apply, exposed so byte budgets elsewhere (the
-    communication-avoiding build accounting in
-    :mod:`raft_tpu.parallel.sharded_ann`, bench columns, docs tables)
-    stay pinned to one source of truth."""
-    if n <= 1:
-        return 0.0
-    return float(_WIRE_FACTORS.get(verb, lambda p, _: p)(float(payload_bytes), int(n)))
+# The per-verb wire model now lives in raft_tpu.parallel.wire_model so
+# the planner, the build byte accounting, and these obs counters all
+# price collectives from one table; re-exported here because this module
+# is where the ``comms.{verb}.bytes`` counters apply it and where every
+# pre-planner consumer imported it from.
+from raft_tpu.parallel.wire_model import (  # noqa: F401  (re-export)
+    WIRE_FACTORS as _WIRE_FACTORS,
+    wire_bytes,
+)
 
 
 def _instrumented(verb: str):

@@ -60,15 +60,24 @@ def _health_array(health, n_shards) -> jnp.ndarray:
 _MERGE_MODES = ("auto", "ring", "fused_ring", "gather")
 
 
-def _resolve_merge_mode(merge_mode: str, n_shards: int) -> str:
+def _resolve_merge_mode(merge_mode: str, n_shards: int, k=None) -> str:
     """``auto`` prefers the ring exchange whenever there is more than one
     shard (parity with gather is exact, wire bytes are ~0.4n× lower); a
     single shard has nothing to exchange and keeps the trivial path.
     ``fused_ring`` keeps the same wire schedule but folds the scan's
-    candidate tile to the merge width inside the ring engine."""
+    candidate tile to the merge width inside the ring engine.
+
+    This is the single merge-engine chokepoint: every sharded search
+    (and the serving engine's sharded registrations, transitively)
+    resolves ``auto`` here, through the planner's wire-model costing
+    when enabled."""
     expects(merge_mode in _MERGE_MODES, "merge_mode %r (want one of %s)",
             merge_mode, _MERGE_MODES)
     if merge_mode == "auto":
+        from raft_tpu import plan as _plan
+
+        if _plan.is_enabled():
+            return _plan.plan_merge_mode(n_shards, k).choice
         return "ring" if n_shards > 1 else "gather"
     if merge_mode == "fused_ring" and n_shards == 1:
         return "gather"
@@ -202,7 +211,7 @@ def sharded_ivf_flat_search(
     g = ivf_flat_mod.scan_chunk_lists(l_local, index.max_list)
 
     masked = health is not None
-    mode = _resolve_merge_mode(merge_mode, n_shards)
+    mode = _resolve_merge_mode(merge_mode, n_shards, k)
     ln = index.list_norms
     if ln is None:
         ln = jnp.zeros(index.list_indices.shape, jnp.float32)
@@ -391,7 +400,7 @@ def sharded_ivf_pq_lists_search(
     bf16 = ivf_pq_mod.scan_bf16(params.lut_dtype)
 
     masked = health is not None
-    mode = _resolve_merge_mode(merge_mode, n_shards)
+    mode = _resolve_merge_mode(merge_mode, n_shards, k)
     put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
     args = [
         put(index.centers, P()),
@@ -415,29 +424,35 @@ def sharded_ivf_pq_lists_search(
 _COMM_MODES = ("auto", "full", "ca")
 
 
-def _resolve_comm_mode(comm_mode: str, n_shards: int) -> str:
+def _resolve_comm_mode(comm_mode: str, n_shards: int, n_rows=None,
+                       d=None, ca_cap=None) -> str:
     """``auto`` prefers the communication-avoiding exchange whenever
     there is more than one shard (wire bytes per iteration drop to the
     changed-row fraction); a single shard pays no wire bytes either way
-    and keeps the reference ``full`` exchange."""
+    and keeps the reference ``full`` exchange.
+
+    With the planner enabled and the accumulator shape known
+    (``n_rows``/``d``), ``auto`` is costed from the consolidated wire
+    model instead — which also keeps ``full`` for degenerate shapes
+    where the CA row cap cannot undercut the full exchange."""
     expects(comm_mode in _COMM_MODES, "comm_mode %r (want one of %s)",
             comm_mode, _COMM_MODES)
     if comm_mode == "auto":
+        from raft_tpu import plan as _plan
+
+        if _plan.is_enabled() and n_rows is not None and d is not None:
+            return _plan.plan_comm_mode(n_rows, d, n_shards, ca_cap=ca_cap).choice
         return "ca" if n_shards > 1 else "full"
     return comm_mode
 
 
 def _ca_cap(n_rows: int, ca_cap) -> int:
-    """Exchanged-row budget for the CA accumulator exchange. The default
-    quarter-width (floored at 8) keeps the byte model ≥ ~2× below the
-    full exchange for any row width the builds use while leaving enough
-    slack that Lloyd's churn fits within a couple of iterations (churn
-    decays geometrically after the first assignment pass)."""
-    if ca_cap is None:
-        ca_cap = min(n_rows, max(8, n_rows // 4))
-    cap = int(ca_cap)
-    expects(1 <= cap <= n_rows, "ca_cap %d outside [1, %d]", cap, n_rows)
-    return cap
+    """Exchanged-row budget for the CA accumulator exchange — the
+    consolidated :func:`raft_tpu.parallel.wire_model.ca_exchange_cap`
+    (kept as the builds' local name)."""
+    from raft_tpu.parallel.wire_model import ca_exchange_cap
+
+    return ca_exchange_cap(n_rows, ca_cap)
 
 
 def _note_build_comms(phase: str, payload_bytes: float, axis: str,
@@ -605,31 +620,14 @@ def dist_codebook_step(books, resid, ksub, axis, fuse_comms=True,
     return jnp.where(cnts[..., None] > 0, new, books)
 
 
-def lloyd_wire_bytes_per_iter(n_lists: int, d: int, n_shards: int,
-                              comm_mode: str = "full", ca_cap=None) -> float:
-    """Wire bytes one rank moves per distributed Lloyd iteration under
-    the :func:`raft_tpu.parallel.comms.wire_bytes` model. ``full`` is the
-    fused ``[n_lists, d+1]`` f32 allreduce; ``ca`` is the steady-state
-    CA exchange — a ``[n_lists]`` changed-count allreduce plus a
-    ``[cap, d+1]`` selected-rows allreduce (the first iteration's
-    carry-seeding full exchange is excluded; it amortises to zero over
-    the training loop)."""
-    from raft_tpu.parallel.comms import wire_bytes
-
-    if comm_mode == "full":
-        return wire_bytes("allreduce", 4.0 * n_lists * (d + 1), n_shards)
-    cap = _ca_cap(n_lists, ca_cap)
-    return (wire_bytes("allreduce", 4.0 * n_lists, n_shards)
-            + wire_bytes("allreduce", 4.0 * cap * (d + 1), n_shards))
-
-
-def codebook_wire_bytes_per_iter(pq_dim: int, ksub: int, pq_len: int, n_shards: int,
-                                 comm_mode: str = "full", ca_cap=None) -> float:
-    """Wire bytes one rank moves per distributed codebook iteration —
-    the :func:`lloyd_wire_bytes_per_iter` model over the flattened
-    ``[pq_dim·ksub, pq_len+1]`` accumulator rows."""
-    return lloyd_wire_bytes_per_iter(pq_dim * ksub, pq_len, n_shards,
-                                     comm_mode=comm_mode, ca_cap=ca_cap)
+# The per-iteration build byte models moved to the consolidated
+# raft_tpu.parallel.wire_model (the planner's comm terms price builds
+# from the same table); re-exported at this original home, where the
+# bench dist_build phase and tests import them from.
+from raft_tpu.parallel.wire_model import (  # noqa: E402,F401  (re-export)
+    codebook_wire_bytes_per_iter,
+    lloyd_wire_bytes_per_iter,
+)
 
 
 def sharded_ivf_pq_build(
@@ -679,7 +677,8 @@ def sharded_ivf_pq_build(
     pq_dim = params.pq_dim or ivf_pq_mod._default_pq_dim(d)
     rot_dim = ((d + pq_dim - 1) // pq_dim) * pq_dim
     ksub = 1 << params.pq_bits
-    mode = _resolve_comm_mode(comm_mode, n_shards)
+    mode = _resolve_comm_mode(comm_mode, n_shards, n_rows=n_lists, d=d,
+                              ca_cap=ca_cap)
 
     key = as_key(params.seed)
     k_init, k_rot = jax.random.split(key)

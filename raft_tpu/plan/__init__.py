@@ -1,0 +1,76 @@
+"""Cost-model query planner: one dispatcher over the vmem / HBM /
+wire / traffic models.
+
+Every ``"auto"`` dispatch decision in raft_tpu — IVF search engine,
+CAGRA beam engine, cross-shard merge engine, distributed-build comm
+mode, mutable delta engine, PQ code family, sparse pairwise engine,
+and the serving engine's per-registration plan — resolves here instead
+of through scattered local heuristics. Each resolver enumerates the
+eligible candidates, prices them from the repo's existing cost models
+(:mod:`raft_tpu.plan.cost`), and returns a typed, explainable
+:class:`Plan`.
+
+Gate: set ``RAFT_TPU_PLAN=0`` (or ``false``/``off``) to disable the
+planner — every call site then runs its original inline heuristic,
+bit-identically. With the gate on, the calibrated cost constants make
+the planner reproduce the legacy choices across the legacy decision
+envelope (pinned by ``tests/test_plan.py``), so results stay
+bit-identical there too.
+"""
+from __future__ import annotations
+
+import os
+
+from raft_tpu.plan.cost import CostTerm
+from raft_tpu.plan.planner import (
+    Candidate,
+    Plan,
+    plan_cagra_mode,
+    plan_comm_mode,
+    plan_delta_mode,
+    plan_merge_mode,
+    plan_pq_kind,
+    plan_search_mode,
+    plan_sparse_mode,
+)
+from raft_tpu.plan.registration import (
+    GROWTH_REPLAN_FACTOR,
+    TRAFFIC_MIN_SAMPLES,
+    WARM_BUCKETS,
+    RegistrationPlan,
+    TrafficSnapshot,
+    needs_replan,
+    plan_registration,
+    traffic_from_counts,
+)
+
+_OFF = ("0", "false", "off", "no")
+
+
+def is_enabled() -> bool:
+    """Planner gate: on by default; ``RAFT_TPU_PLAN=0`` restores every
+    call site's original inline heuristic."""
+    return os.environ.get("RAFT_TPU_PLAN", "1").strip().lower() not in _OFF
+
+
+__all__ = [
+    "Candidate",
+    "CostTerm",
+    "GROWTH_REPLAN_FACTOR",
+    "Plan",
+    "RegistrationPlan",
+    "TRAFFIC_MIN_SAMPLES",
+    "TrafficSnapshot",
+    "WARM_BUCKETS",
+    "is_enabled",
+    "needs_replan",
+    "plan_cagra_mode",
+    "plan_comm_mode",
+    "plan_delta_mode",
+    "plan_merge_mode",
+    "plan_pq_kind",
+    "plan_registration",
+    "plan_search_mode",
+    "plan_sparse_mode",
+    "traffic_from_counts",
+]

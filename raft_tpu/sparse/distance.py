@@ -73,6 +73,17 @@ _NATIVE_UNION = frozenset(
 _NATIVE = _NATIVE_GRAM | _NATIVE_UNION
 
 
+def _plan_sparse(n_cols: int, metric) -> str:
+    """Resolve ``mode="auto"``: densify vs native-CSR, costed by the
+    planner (gate off restores the legacy width threshold)."""
+    from raft_tpu import plan as _plan
+
+    native_ok = metric in _NATIVE
+    if _plan.is_enabled():
+        return _plan.plan_sparse_mode(n_cols, native_ok=native_ok).choice
+    return "native" if n_cols > (1 << 18) and native_ok else "densify"
+
+
 def _densify_rows(a: CSR, start: int, count: int, rows=None) -> jax.Array:
     """Dense [count, n_cols] block of CSR rows [start, start+count);
     ``rows`` is the precomputed ``a.row_ids()`` (hoist it out of block
@@ -314,7 +325,9 @@ def pairwise_distance_sparse(
     metric = resolve_metric(metric)
     expects(x.shape[1] == y.shape[1], "feature dim mismatch")
     expects(mode in ("auto", "densify", "native"), "bad mode %r", mode)
-    if mode == "native" or (mode == "auto" and x.shape[1] > (1 << 18) and metric in _NATIVE):
+    if mode == "auto":
+        mode = _plan_sparse(x.shape[1], metric)
+    if mode == "native":
         return pairwise_distance_sparse_native(x, y, metric, metric_arg=metric_arg)
     m = x.shape[0]
     x_rows = x.row_ids()
@@ -361,7 +374,9 @@ def knn_sparse(
     worst = jnp.float32(worst_value(jnp.float32, select_min))
 
     expects(mode in ("auto", "densify", "native"), "bad mode %r", mode)
-    if mode == "native" or (mode == "auto" and x.shape[1] > (1 << 18) and metric in _NATIVE):
+    if mode == "auto":
+        mode = _plan_sparse(x.shape[1], metric)
+    if mode == "native":
         d = pairwise_distance_sparse_native(x, y, metric, metric_arg=metric_arg)
         return select_k(d, k, select_min=select_min)
 

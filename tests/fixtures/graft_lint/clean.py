@@ -303,3 +303,27 @@ class CleanWorker:
         self._stop.set()
         if self._thread is not None:
             self._thread.join()
+
+
+def plan_routed_search(index, queries, k, mode="auto"):
+    # scattered-auto negative: the "auto" branch routes through the
+    # planner; the gate-off legacy heuristic in the same function is
+    # the sanctioned pattern
+    from raft_tpu import plan as _plan
+
+    nq = queries.shape[0]
+    if mode == "auto":
+        if _plan.is_enabled():
+            mode = _plan.plan_search_mode(
+                "ivf_flat", nq, on_tpu=False, fused_ok=False
+            ).choice
+        else:
+            mode = "scan" if nq >= 128 else "probe"
+    return index.run(queries, k, mode)
+
+
+def validate_mode(mode):
+    # scattered-auto negative: membership validation is input checking,
+    # not a dispatch decision
+    assert mode in ("auto", "scan", "probe", "fused")
+    return mode

@@ -44,6 +44,7 @@ BAD = {
     "bad_guarded_field.py": "guarded-field",
     "bad_guard_inference.py": "guard-inference",
     "bad_thread_lifecycle.py": "thread-lifecycle",
+    "bad_scattered_auto.py": "scattered-auto",
 }
 
 

@@ -74,6 +74,7 @@ def test_new_rules_run_strict_and_clean(project):
         "lock-order", "collective-divergence",
         "metric-drift", "fault-point-drift", "orphan-span",
         "guarded-field", "guard-inference", "thread-lifecycle",
+        "scattered-auto",
     ])
     assert not strict, "\n".join(v.render() for v in strict)
 

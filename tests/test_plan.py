@@ -1,0 +1,375 @@
+"""Cost-model query planner: legacy parity, explainability, and live
+re-planning.
+
+The planner's contract (docs/planner.md) has three legs, each pinned
+here:
+
+* **parity** — with the gate on, every ``plan_*`` resolver reproduces
+  the legacy inline heuristic it replaced across that heuristic's whole
+  decision envelope, and gates-off results are bit-identical to the
+  planned ones (the planner resolves to the configs the heuristics
+  chose on these shapes);
+* **explainability** — every decision is a typed :class:`Plan` whose
+  explain() carries a per-term cost breakdown for every candidate,
+  including losers and ineligibles;
+* **re-planning** — the serving engine re-costs a drifting
+  registration from its maintenance tick, swaps the plan atomically
+  (``serve.plan_flips``), keeps recompiles bounded by engines ×
+  buckets, and never surfaces an error to a caller in flight.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu import plan as planlib
+from raft_tpu.mutable import MutableIndex
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.serve.bucketing import bucket_sizes
+from raft_tpu.serve.engine import ServingEngine
+
+
+@pytest.fixture
+def serve_obs():
+    reg = obs.registry()
+    reg.reset()
+    obs.enable()
+    yield reg
+    obs.disable()
+    reg.reset()
+
+
+def _counter(registry, name, **labels):
+    """Sum of every counter sample matching ``name`` and ``labels``."""
+    snap = registry.as_dict()["counters"]
+    total = 0.0
+    for key, value in snap.items():
+        if not key.startswith(name):
+            continue
+        if all(f'{k}="{v}"' in key for k, v in labels.items()):
+            total += value
+    return total
+
+
+# -- gate --------------------------------------------------------------------
+
+
+def test_gate_default_on_and_env_off(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_PLAN", raising=False)
+    assert planlib.is_enabled()
+    for off in ("0", "false", "OFF", " no "):
+        monkeypatch.setenv("RAFT_TPU_PLAN", off)
+        assert not planlib.is_enabled()
+    monkeypatch.setenv("RAFT_TPU_PLAN", "1")
+    assert planlib.is_enabled()
+
+
+# -- per-decision legacy parity ----------------------------------------------
+
+
+NQ_SWEEP = list(range(1, 16)) + [63, 64, 126, 127, 128, 129, 192, 256, 1024]
+
+
+class TestLegacyParity:
+    @pytest.mark.parametrize("on_tpu", [False, True])
+    @pytest.mark.parametrize("fused_ok", [False, True])
+    @pytest.mark.parametrize("wants_f32_lut", [False, True])
+    def test_ivf_search_mode(self, on_tpu, fused_ok, wants_f32_lut):
+        """ivf_pq/ivf_flat mode="auto": the probe/scan/fused three-way."""
+        for nq in NQ_SWEEP:
+            if nq >= 128 and on_tpu and fused_ok and not wants_f32_lut:
+                legacy = "fused"
+            else:
+                legacy = "scan" if nq >= 128 else "probe"
+            for algo in ("ivf_pq", "ivf_flat"):
+                p = planlib.plan_search_mode(
+                    algo, nq, on_tpu=on_tpu, fused_ok=fused_ok,
+                    wants_f32_lut=wants_f32_lut)
+                assert p.choice == legacy, (algo, nq, on_tpu, fused_ok,
+                                            wants_f32_lut, p.explain())
+
+    @pytest.mark.parametrize("on_tpu", [False, True])
+    @pytest.mark.parametrize("fused_ok", [False, True])
+    def test_cagra_mode(self, on_tpu, fused_ok):
+        for nq in NQ_SWEEP:
+            legacy = "fused" if on_tpu and fused_ok else "xla"
+            p = planlib.plan_cagra_mode(nq, on_tpu=on_tpu, fused_ok=fused_ok)
+            assert p.choice == legacy, (nq, on_tpu, fused_ok, p.explain())
+
+    def test_merge_mode(self):
+        for n_shards in (1, 2, 3, 4, 8, 16):
+            for k in (1, 5, 10, 64, 128):
+                legacy = "ring" if n_shards > 1 else "gather"
+                p = planlib.plan_merge_mode(n_shards, k)
+                assert p.choice == legacy, (n_shards, k, p.explain())
+
+    def test_merge_mode_fused_ring_wins_with_wide_tile(self):
+        """The model sees what the legacy auto could not: with the
+        scan's candidate tile wider than k, folding inside the ring
+        engine skips the HBM round-trip — fused_ring wins."""
+        p = planlib.plan_merge_mode(4, 10, tile_width=64)
+        assert p.choice == "fused_ring", p.explain()
+        assert p.candidate("ring").cost > p.cost
+
+    def test_comm_mode(self):
+        # legacy: ca whenever n_shards > 1 — the planner agrees on
+        # every real accumulator shape (row cap < full rows)
+        for n_shards in (2, 4, 8):
+            for n_rows in (32, 256, 4096):
+                for d in (8, 64, 768):
+                    p = planlib.plan_comm_mode(n_rows, d, n_shards)
+                    assert p.choice == "ca", (n_rows, d, n_shards, p.explain())
+        assert planlib.plan_comm_mode(4096, 64, 1).choice == "full"
+
+    def test_comm_mode_degenerate_cap_keeps_full(self):
+        """Documented deviation (docs/planner.md): when the CA row cap
+        cannot undercut the full exchange, the wire model keeps full —
+        and the model's own byte terms justify it."""
+        p = planlib.plan_comm_mode(4, 8, 2, ca_cap=4)  # cap == rows
+        assert p.choice == "full", p.explain()
+        wire = {c.name: sum(t.value for t in c.terms if t.name == "wire")
+                for c in p.candidates}
+        assert wire["ca"] >= wire["full"]
+
+    @pytest.mark.parametrize("eligible", [False, True])
+    @pytest.mark.parametrize("on_tpu", [False, True])
+    def test_delta_mode(self, eligible, on_tpu):
+        legacy = "fused" if eligible and on_tpu else "exact"
+        p = planlib.plan_delta_mode(eligible=eligible, on_tpu=on_tpu)
+        assert p.choice == legacy, p.explain()
+
+    @pytest.mark.parametrize("per_subspace", [False, True])
+    def test_pq_kind(self, per_subspace):
+        for pq_bits in range(1, 9):
+            if pq_bits == 1:
+                legacy = "rabitq"
+            else:
+                legacy = "nibble" if pq_bits == 8 and per_subspace else "kmeans"
+            p = planlib.plan_pq_kind(pq_bits, per_subspace)
+            assert p.choice == legacy, (pq_bits, per_subspace, p.explain())
+
+    def test_sparse_mode(self):
+        B = 1 << 18
+        for n_cols in (16, B - 1, B, B + 1, B * 4):
+            for native_ok in (False, True):
+                legacy = "native" if n_cols > B and native_ok else "densify"
+                p = planlib.plan_sparse_mode(n_cols, native_ok=native_ok)
+                assert p.choice == legacy, (n_cols, native_ok, p.explain())
+
+
+# -- gates-off bit-identical parity ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_corpus():
+    rng = np.random.default_rng(11)
+    X = rng.standard_normal((512, 16)).astype(np.float32)
+    Q = rng.standard_normal((130, 16)).astype(np.float32)
+    return X, Q
+
+
+class TestBitParity:
+    """The same search, planner on vs. gate off, must produce the same
+    bits — the planner resolves to the configs the heuristics chose."""
+
+    def _run(self, fn, enabled, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PLAN", "1" if enabled else "0")
+        return fn()
+
+    def test_ivf_pq_auto_search(self, small_corpus, monkeypatch):
+        X, Q = small_corpus
+        idx = ivf_pq.build(X, ivf_pq.IvfPqIndexParams(
+            n_lists=8, pq_dim=8, seed=3))
+
+        def run():
+            d, i = ivf_pq.search(idx, Q, 10, ivf_pq.IvfPqSearchParams(
+                n_probes=4), mode="auto")
+            return np.asarray(d), np.asarray(i)
+
+        d_on, i_on = self._run(run, True, monkeypatch)
+        d_off, i_off = self._run(run, False, monkeypatch)
+        np.testing.assert_array_equal(i_on, i_off)
+        np.testing.assert_array_equal(d_on, d_off)
+
+    def test_ivf_flat_auto_search_both_sides_of_128(self, small_corpus,
+                                                    monkeypatch):
+        X, Q = small_corpus
+        idx = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=3))
+        for nq in (4, 130):  # probe side and scan side of the crossover
+            def run():
+                d, i = ivf_flat.search(idx, Q[:nq], 10,
+                                       ivf_flat.IvfFlatSearchParams(n_probes=4),
+                                       mode="auto")
+                return np.asarray(d), np.asarray(i)
+
+            d_on, i_on = self._run(run, True, monkeypatch)
+            d_off, i_off = self._run(run, False, monkeypatch)
+            np.testing.assert_array_equal(i_on, i_off)
+            np.testing.assert_array_equal(d_on, d_off)
+
+    def test_engine_serving_bit_identical(self, small_corpus, monkeypatch):
+        X, Q = small_corpus
+        idx = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=3))
+
+        def serve():
+            eng = ServingEngine(max_batch=16, max_wait_ms=0.0)
+            eng.register("t", "ivf_flat", idx,
+                         params=ivf_flat.IvfFlatSearchParams(n_probes=4))
+            fut = eng.submit("t", Q[:6], k=5)
+            eng.run_until_idle()
+            r = fut.result()
+            return np.asarray(r.distances), np.asarray(r.indices)
+
+        d_on, i_on = self._run(serve, True, monkeypatch)
+        d_off, i_off = self._run(serve, False, monkeypatch)
+        np.testing.assert_array_equal(i_on, i_off)
+        np.testing.assert_array_equal(d_on, d_off)
+
+
+# -- explain format ----------------------------------------------------------
+
+
+class TestExplain:
+    def test_plan_explain_carries_every_candidate(self):
+        p = planlib.plan_search_mode("ivf_pq", 8, on_tpu=False, fused_ok=False)
+        text = p.explain()
+        assert "ivf_pq.search_mode" in text and "probe" in text
+        assert "scan" in text and "fused" in text
+        assert "ineligible" in text          # losers explain why
+        assert "cu" in text                  # per-term cost units
+        assert "nq=8" in text                # inputs recorded
+
+    def test_registration_plan_explain(self, small_corpus):
+        X, _ = small_corpus
+        idx = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=3))
+        eng = ServingEngine(max_batch=16, max_wait_ms=0.0)
+        eng.register("exp", "ivf_flat", idx,
+                     params=ivf_flat.IvfFlatSearchParams(n_probes=4))
+        text = eng.plan_explain("exp")
+        assert text is not None
+        assert "plan[exp]" in text and "epoch=0" in text
+        assert "bucket modes:" in text
+        for b in bucket_sizes(16):  # one costed engine per bucket
+            assert f" {b}→" in text
+
+    def test_decisions_metric_emitted(self, serve_obs):
+        planlib.plan_merge_mode(4, 10)
+        snap = serve_obs.as_dict()["counters"]
+        assert any(k.startswith("plan.decisions") for k in snap), snap
+
+
+# -- live re-planning under drift --------------------------------------------
+
+
+class TestReplanning:
+    def _engine(self, X, max_batch=16):
+        idx = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=3))
+        eng = ServingEngine(max_batch=max_batch, max_wait_ms=0.0)
+        eng.register("drift", "ivf_flat", idx,
+                     params=ivf_flat.IvfFlatSearchParams(n_probes=4))
+        return eng
+
+    def _pump(self, eng, Q, nq, batches, k=5):
+        outs = []
+        for _ in range(batches):
+            fut = eng.submit("drift", Q[:nq], k=k)
+            eng.run_until_idle()
+            outs.append(fut.result())  # raises if dispatch errored
+        return outs
+
+    def test_traffic_shift_flips_plan_without_caller_error(
+            self, small_corpus, serve_obs):
+        X, Q = small_corpus
+        eng = self._engine(X)
+        plan0 = eng._indexes["drift"].plan
+        assert plan0 is not None and plan0.epoch == 0
+        # traffic arrives concentrated on one bucket; past
+        # TRAFFIC_MIN_SAMPLES the dominant bucket diverges from the
+        # plan's cold anchor and the tick must re-plan
+        self._pump(eng, Q, nq=7, batches=planlib.TRAFFIC_MIN_SAMPLES + 2)
+        eng.maintenance_tick()
+        plan1 = eng._indexes["drift"].plan
+        assert plan1.epoch == plan0.epoch + 1
+        assert plan1.dominant_bucket == 8
+        assert 8 in plan1.warm_buckets
+        assert _counter(serve_obs, "serve.plan_flips", index_id="drift") == 1
+        # serving continues on the new plan, no caller-visible error
+        res = self._pump(eng, Q, nq=7, batches=2)[-1]
+        assert np.asarray(res.indices).shape == (7, 5)
+
+    def test_recost_without_decision_change_keeps_epoch(
+            self, small_corpus, serve_obs):
+        X, Q = small_corpus
+        eng = self._engine(X)
+        reg = eng._indexes["drift"]
+        self._pump(eng, Q, nq=7, batches=planlib.TRAFFIC_MIN_SAMPLES + 2)
+        eng.maintenance_tick()  # flip 1: cold anchors -> live traffic
+        epoch = reg.plan.epoch
+        # corpus growth past the hysteresis factor with unchanged
+        # traffic: decisions cannot change (bucket engines are a pure
+        # function of bucket size on CPU) -> re-cost, not flip
+        self._pump(eng, Q, nq=7, batches=planlib.TRAFFIC_MIN_SAMPLES + 2)
+        anchor = int(reg.plan.corpus_rows // (planlib.GROWTH_REPLAN_FACTOR * 2))
+        reg.plan = dataclasses.replace(reg.plan, corpus_rows=anchor)
+        eng.maintenance_tick()
+        assert _counter(serve_obs, "serve.plan.recosts", index_id="drift") == 1
+        assert reg.plan.epoch == epoch          # no epoch burn
+        assert reg.plan.corpus_rows == 512      # anchors refreshed
+        assert _counter(serve_obs, "serve.plan_flips", index_id="drift") == 1
+
+    def test_hysteresis_holds_plan_inside_thresholds(self, small_corpus):
+        X, Q = small_corpus
+        eng = self._engine(X)
+        reg = eng._indexes["drift"]
+        plan0 = reg.plan
+        # a handful of batches: below TRAFFIC_MIN_SAMPLES, no growth
+        self._pump(eng, Q, nq=7, batches=3)
+        eng.maintenance_tick()
+        assert reg.plan is plan0  # untouched — not even a re-cost
+
+    def test_recompiles_bounded_by_engines_times_buckets(self, small_corpus):
+        """A flip whose bucket engines did not change must reuse every
+        cached program: total misses stay <= one per (bucket, engine)
+        pair ever dispatched or warmed."""
+        X, Q = small_corpus
+        eng = self._engine(X)
+        self._pump(eng, Q, nq=7, batches=planlib.TRAFFIC_MIN_SAMPLES + 2)
+        eng.maintenance_tick()   # flip (warm set changed)
+        self._pump(eng, Q, nq=7, batches=4)
+        st = eng.cache.stats()
+        # bucket 8 dispatched (1 miss) + warm-bucket precompiles at the
+        # flip (<= WARM_BUCKETS; the engine for bucket 8 did not change,
+        # so its warmed key re-uses the dispatched program); everything
+        # after the flip must hit
+        assert st.misses <= 1 + planlib.WARM_BUCKETS, st
+        assert st.hits >= planlib.TRAFFIC_MIN_SAMPLES, st
+
+    def test_mutable_growth_recosts_from_tick(self, serve_obs):
+        """A mutable registration's plan carries corpus anchors; real
+        insert-driven growth past GROWTH_REPLAN_FACTOR re-costs it."""
+        rng = np.random.default_rng(5)
+        mi = MutableIndex("brute_force", 8)
+        mi.insert(rng.standard_normal((64, 8)).astype(np.float32))
+        eng = ServingEngine(max_batch=8, max_wait_ms=0.0)
+        eng.register_mutable("grow", mi)
+        reg = eng._indexes["grow"]
+        assert reg.plan is not None and reg.plan.corpus_rows == 64
+        mi.insert(rng.standard_normal((64, 8)).astype(np.float32))
+        eng.maintenance_tick()
+        assert _counter(serve_obs, "serve.plan.recosts", index_id="grow") == 1
+        assert reg.plan.corpus_rows == 128
+
+
+# -- planner stays out of the way when pinned --------------------------------
+
+
+def test_pinned_mode_never_planned(small_corpus):
+    X, _ = small_corpus
+    idx = ivf_flat.build(X, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=3))
+    eng = ServingEngine(max_batch=16, max_wait_ms=0.0)
+    eng.register("pinned", "ivf_flat", idx, mode="scan",
+                 params=ivf_flat.IvfFlatSearchParams(n_probes=4))
+    plan = eng._indexes["pinned"].plan
+    assert plan is not None
+    assert plan.bucket_modes == ()  # an explicit pin is never second-guessed

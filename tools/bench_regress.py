@@ -51,6 +51,10 @@ _METRICS = {
     # traffic regresses by growing, the full:ca reduction by shrinking
     "wire_bytes_per_iter": (-1, "ratio", "bytes_rise"),
     "build_bytes_ratio": (+1, "ratio", "bytes_rise"),
+    # planner phase column (bench.py): planner QPS / best hand-tuned QPS
+    # at the same recall floor — 1.0 means the cost models found the
+    # measured frontier; regresses by dropping
+    "planner_regret": (+1, "absolute", "regret_drop"),
 }
 
 
@@ -233,6 +237,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--overlap-drop", type=float, default=0.25,
                     help="flag absolute overlap_efficiency drops beyond this "
                          "(default 0.25)")
+    ap.add_argument("--regret-drop", type=float, default=0.05,
+                    help="flag absolute planner_regret drops beyond this "
+                         "(default 0.05)")
     ap.add_argument("--ms-floor", type=float, default=0.05,
                     help="ignore p99 deltas when both sides sit under this")
     ap.add_argument("--smoke", action="store_true",

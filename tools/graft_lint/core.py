@@ -652,6 +652,7 @@ def all_checkers() -> List[Checker]:
     from tools.graft_lint import (
         comms_rules,
         concurrency_rules,
+        dispatch_rules,
         guard_rules,
         jax_rules,
         pallas_rules,
@@ -667,6 +668,7 @@ def all_checkers() -> List[Checker]:
         *concurrency_rules.CHECKERS,
         *guard_rules.CHECKERS,
         *registry_rules.CHECKERS,
+        *dispatch_rules.CHECKERS,
     ]
 
 

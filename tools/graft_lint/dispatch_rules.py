@@ -1,0 +1,112 @@
+"""Dispatch-policy checkers: "auto" resolution must route through the
+planner.
+
+The repo's dispatch decisions ("auto" search/merge/comm/delta modes)
+resolve through one costed chokepoint — ``raft_tpu.plan`` — so every
+policy is explainable from a single cost table instead of re-derived by
+scattered one-liner heuristics that drift apart:
+
+* ``scattered-auto`` — an ``== "auto"`` / ``!= "auto"`` string-literal
+  comparison inside a function with no reference to the planner is a
+  local dispatch heuristic growing outside the chokepoint. Route the
+  branch through a ``raft_tpu.plan`` resolver (gate-off legacy branches
+  in the same function are fine — the planner reference marks the
+  function as routed), or carry a rationale'd inline suppression.
+
+Membership validations (``mode in ("auto", ...)``) are not flagged —
+an allowlist check is input validation, not dispatch. Only equality
+comparisons against the literal decide a branch.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.graft_lint.core import Checker, LintModule, Violation
+
+#: attribute/name spellings that mark a function as planner-routed
+_PLAN_PREFIXES = ("plan_", "_plan", "planned_")
+
+
+def _is_plan_ref(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        nid = node.id
+        return nid == "plan" or nid.endswith("_plan") or nid.startswith(_PLAN_PREFIXES)
+    if isinstance(node, ast.Attribute):
+        attr = node.attr
+        return attr == "plan" or attr.startswith(_PLAN_PREFIXES)
+    if isinstance(node, ast.ImportFrom):
+        mod = node.module or ""
+        if mod == "raft_tpu.plan" or mod.startswith("raft_tpu.plan."):
+            return True
+        return mod == "raft_tpu" and any(a.name == "plan" for a in node.names)
+    return False
+
+
+def _routes_through_planner(fn: ast.AST) -> bool:
+    return any(_is_plan_ref(n) for n in ast.walk(fn))
+
+
+def _auto_compares(fn: ast.AST, nested: List[ast.AST]) -> Iterator[ast.Compare]:
+    """Eq/NotEq comparisons against the literal "auto" directly in
+    ``fn`` (not inside one of its ``nested`` function definitions —
+    those are scoped to the nested function's own walk)."""
+    skip = set()
+    for sub in nested:
+        skip.update(id(n) for n in ast.walk(sub))
+        skip.discard(id(sub))
+    for node in ast.walk(fn):
+        if id(node) in skip or not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left, *node.comparators]
+        literal = any(
+            isinstance(s, ast.Constant) and s.value == "auto" for s in sides  # graft-lint: ignore[scattered-auto] — the detector's own matching literal, not a dispatch branch
+        )
+        if literal and all(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            yield node
+
+
+class ScatteredAutoChecker(Checker):
+    rule = "scattered-auto"
+    doc = (
+        'string-literal "auto" dispatch branch in a function that never '
+        "references the planner — resolve the decision through a "
+        "raft_tpu.plan resolver so every policy prices from one cost "
+        "table instead of a drifting local heuristic"
+    )
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        funcs = [
+            n for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in funcs:
+            nested = [
+                n for n in ast.walk(fn)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            ]
+            cmps = list(_auto_compares(fn, nested))
+            if not cmps:
+                continue
+            # the function (or any function nesting it) routing through
+            # the planner clears its whole subtree: a gate-off legacy
+            # branch next to the planner call is the sanctioned pattern
+            if any(
+                _routes_through_planner(f)
+                for f in funcs
+                if f is fn or any(n is fn for n in ast.walk(f))
+            ):
+                continue
+            for cmp_node in cmps:
+                yield self.violation(
+                    module, cmp_node,
+                    '"auto" resolved by a local heuristic in '
+                    f"{fn.name}() — route the decision through a "
+                    "raft_tpu.plan resolver (plan_search_mode, "
+                    "plan_merge_mode, ...) so the choice is costed and "
+                    "explainable, or suppress with a rationale",
+                )
+
+
+CHECKERS = [ScatteredAutoChecker()]
