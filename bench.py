@@ -1141,6 +1141,91 @@ def _bench_main():
                 print(f"# serve chaos: worst trace {worst} resolved to "
                       f"{len(tspans)} spans ({', '.join(sorted(tnames))})",
                       flush=True)
+            # obs-overhead sub-phase: the flight-recorder contract — an
+            # installed recorder + series bank (ticking on maintenance)
+            # must cost the serve row <2% QPS and <5% p99 ON TOP of the
+            # base obs layer, so both arms run with obs enabled (the
+            # serve row's normal state) and only the recorder is
+            # installed/uninstalled between arms; triggers=() keeps
+            # auto-dumps out of the measurement window. Alternating
+            # on/off closed-loop pairs, best-of per mode to de-noise;
+            # the fraction lands in the artifact row and
+            # tools/bench_regress.py gates it across rounds
+            # (--overhead-rise).
+            if serve_targets:
+                try:
+                    import tempfile as _tempfile
+
+                    from raft_tpu.obs import recorder as _recorder
+
+                    index_id, _salgo = serve_targets[0]
+                    was_on = obs.is_enabled()
+                    obs.enable()
+                    rdir = _tempfile.mkdtemp(prefix="raft_tpu_obs_ovh_")
+                    # window must span several sample_interval_s periods
+                    # (250ms) or a single ~0.3ms registry scan quantizes
+                    # into the percentage; ~2k requests ≈ 1s closed-loop
+                    n_ovh = max(4 * n_req, 2048)
+                    qps = {"on": [], "off": []}
+                    p99 = {"on": [], "off": []}
+                    for _round in range(3):
+                        for mode in ("on", "off"):
+                            if mode == "on":
+                                rec = _recorder.install(
+                                    rdir, min_dump_interval_s=1e9,
+                                    triggers=(),
+                                )
+                                rec.attach_engine(engine)
+                            else:
+                                _recorder.uninstall()
+                            rep_m, _ = run_closed_loop(
+                                engine, index_id, qpool, K,
+                                concurrency=16, n_requests=n_ovh,
+                                request_rows=srows,
+                            )
+                            qps[mode].append(rep_m.throughput_qps)
+                            p99[mode].append(rep_m.latency_ms_p99)
+                    _recorder.uninstall()
+                    if was_on:
+                        obs.enable()
+                    else:
+                        obs.disable()
+                    qps_on, qps_off = max(qps["on"]), max(qps["off"])
+                    p99_on, p99_off = min(p99["on"]), min(p99["off"])
+                    ovh = max(0.0, 1.0 - qps_on / qps_off)
+                    p99_ovh = max(0.0, p99_on / p99_off - 1.0)
+                    orow = {
+                        "config": (
+                            f"recorder on/off (obs on both) "
+                            f"c=16 rows={srows}"
+                        ),
+                        "qps": round(qps_on, 1),
+                        "qps_off": round(qps_off, 1),
+                        "p99_ms": round(p99_on, 3),
+                        "p99_off_ms": round(p99_off, 3),
+                        "recorder_overhead_frac": round(ovh, 4),
+                        "p99_overhead_frac": round(p99_ovh, 4),
+                    }
+                    results.setdefault("serve_obs_overhead", []).append(orow)
+                    _rec_add({"algo": "serve_obs_overhead", **orow})
+                    print(
+                        f"# serve obs_overhead: qps {qps_on:.0f} (on) vs "
+                        f"{qps_off:.0f} (off) -> {ovh:.2%}; p99 "
+                        f"{p99_on:.2f} vs {p99_off:.2f} ms -> {p99_ovh:.2%}",
+                        flush=True,
+                    )
+                    assert ovh < 0.02, (
+                        f"recorder+timeseries QPS overhead {ovh:.2%} >= 2%"
+                    )
+                    assert p99_ovh < 0.05, (
+                        f"recorder+timeseries p99 overhead {p99_ovh:.2%} >= 5%"
+                    )
+                except Exception as e:  # noqa: BLE001
+                    phase_errors["obs_overhead"] = (
+                        f"{type(e).__name__}: {e}"[:200]
+                    )
+                    print(f"# obs_overhead failed: "
+                          f"{phase_errors['obs_overhead']}", flush=True)
             cs = engine.cache.stats()
             print(f"# serve cache: {cs.distinct_programs} compiled programs "
                   f"({cs.hits} hits / {cs.misses} misses)", flush=True)
@@ -2119,6 +2204,9 @@ def _bench_main():
                 }
                 for algo, rows in results.items()
                 for r in rows
+                # overhead rows (serve_obs_overhead) carry no recall —
+                # they are not QPS@recall datapoints
+                if "recall" in r
             ],
         }
         os.makedirs("bench_artifacts", exist_ok=True)

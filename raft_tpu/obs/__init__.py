@@ -43,33 +43,54 @@ from raft_tpu.obs.request import (
     new_trace_id,
     trace_scope,
 )
+from raft_tpu.obs import recorder, timeseries
+from raft_tpu.obs.recorder import FlightRecorder, list_bundles, load_bundle
 from raft_tpu.obs.slo import SLO, SloStatus, SloTracker
 from raft_tpu.obs.spans import Span, span, traced
+from raft_tpu.obs.timeseries import (
+    Anomaly,
+    EwmaDetector,
+    HistogramSeries,
+    SeriesBank,
+    TimeSeries,
+    default_detectors,
+)
 
 __all__ = [
+    "Anomaly",
     "DEFAULT_BUCKETS",
     "Counter",
+    "EwmaDetector",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "HistogramSeries",
     "NULL_SCOPE",
     "Registry",
     "SLO",
+    "SeriesBank",
     "SloStatus",
     "SloTracker",
     "Span",
+    "TimeSeries",
     "chrome_trace",
     "current_trace",
+    "default_detectors",
     "disable",
     "enable",
     "inc",
     "is_enabled",
     "iter_trace_spans",
+    "list_bundles",
+    "load_bundle",
     "load_trace",
     "new_trace_id",
     "observe",
+    "recorder",
     "registry",
     "set_gauge",
     "span",
+    "timeseries",
     "trace_scope",
     "traced",
     "validate_trace",

@@ -222,6 +222,33 @@ class Registry:
             return
         self.histogram(name, **labels).observe(value, trace_id=trace_id)
 
+    # -- sampling ----------------------------------------------------------
+
+    def sample(
+        self, prefixes: Optional[Sequence[str]] = None
+    ) -> List[Tuple[str, str, LabelsKey, Any]]:
+        """One consistent snapshot of instrument values for time-series
+        retention (:mod:`raft_tpu.obs.timeseries`): ``(kind, name,
+        labels, payload)`` rows, where payload is the value for
+        counters/gauges and ``(buckets, counts, sum, count)`` for
+        histograms. ``prefixes`` filters by name prefix; like the dump
+        paths, the whole scan runs under the shared instrument lock so
+        a row can never carry a torn sum/count pair."""
+        pref = tuple(prefixes) if prefixes else None
+        out: List[Tuple[str, str, LabelsKey, Any]] = []
+        with self._lock:
+            for m in self._metrics.values():
+                if pref is not None and not m.name.startswith(pref):
+                    continue
+                if m.kind == "histogram":
+                    payload: Any = (
+                        m.buckets, tuple(m.counts), m.sum, m.count
+                    )
+                else:
+                    payload = m.value
+                out.append((m.kind, m.name, m.labels, payload))
+        return out
+
     # -- spans ------------------------------------------------------------
 
     def now_us(self) -> float:

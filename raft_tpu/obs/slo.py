@@ -34,7 +34,7 @@ from collections import deque
 from typing import Any, Callable, Deque, Dict, Optional, Tuple
 
 from raft_tpu.core.errors import expects
-from raft_tpu.obs import metrics
+from raft_tpu.obs import metrics, recorder
 from raft_tpu.utils import lockcheck
 
 #: hard cap on retained window events per tracker (memory backstop; the
@@ -205,4 +205,10 @@ class SloTracker:
             if transition is not None:
                 metrics.inc("slo.alerts", index_id=slo.index_id,
                             transition=transition)
+                # flight-recorder trigger: rides the same outside-lock
+                # emission point, so obs.recorder (like obs.registry
+                # here) is never acquired under obs.slo
+                recorder.note_slo_transition(
+                    slo.index_id, transition, burn_fast, burn_slow
+                )
         return status

@@ -472,7 +472,9 @@ class ReplicaGroup:
     def health(self) -> Dict[str, object]:
         """Group health: per-replica breaker/queue/staleness plus the
         in-flight and parked counts. Each replica's full engine health
-        snapshot rides under ``engine``."""
+        snapshot rides under ``engine``; ``cluster`` is the aggregated
+        one-line snapshot (worst breaker, max staleness, summed queue
+        depth) dashboards and flight-recorder bundles consume."""
         with self._lock:
             in_flight = len(self._flights)
             parked = len(self._parked)
@@ -487,9 +489,25 @@ class ReplicaGroup:
                 "staleness_records": self.router.staleness(rid),
                 "engine": eng.health(),
             })
+        severity = {"closed": 0, "half_open": 1, "open": 2}
+        cluster = {
+            "replicas": len(replicas),
+            "worst_breaker": (
+                max(states, key=lambda s: severity.get(s, 0))
+                if states else "closed"
+            ),
+            "open_breakers": sum(1 for s in states if s == "open"),
+            "max_staleness_records": max(
+                (r["staleness_records"] for r in replicas), default=0
+            ),
+            "queue_rows": sum(r["queue_rows"] for r in replicas),
+            "in_flight": in_flight,
+            "parked": parked,
+        }
         return {
             "name": self.name,
             "replicas": replicas,
+            "cluster": cluster,
             "in_flight": in_flight,
             "parked": parked,
             "threaded": bool(self._threads),

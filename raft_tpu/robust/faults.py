@@ -88,6 +88,7 @@ FAULT_POINTS = (
     "replica.dispatch",       # replica/group.py per-replica pump (before engine.step)
     "wal.ship",               # replica/shipping.py sealed-frame transfer to a follower
     "replica.apply",          # replica/shipping.py follower replay of a shipped chunk
+    "recorder.dump",          # obs/recorder.py mid-bundle-write (torn-dump drill)
 )
 
 
@@ -181,6 +182,11 @@ class FaultRegistry:
                 continue
             kind = type(spec.error).__name__ if spec.error is not None else "latency"
             obs.inc("faults.fired", point=point, kind=kind)
+            # flight-recorder hook: rides the same outside-lock spot as
+            # the counter. The note path is lock-free by contract — this
+            # seam may be firing inside another subsystem's critical
+            # section (e.g. wal.append under the writer lock)
+            obs.recorder.note_fault(point, kind)
             if spec.latency_s > 0.0:
                 time.sleep(spec.latency_s)
             if spec.error is not None:

@@ -657,6 +657,10 @@ class ServingEngine:
             if reg.compactor is not None:
                 reg.compactor.tick()
         self._replan_tick()
+        # flight-recorder sampler tick: retains the serving time series
+        # and drains any fault-latched dump; no-op unless a recorder is
+        # installed AND obs is enabled
+        obs.recorder.tick()
 
     def shutdown(self, wait: bool = True) -> None:
         """Stop every engine-owned background compactor. Queued
@@ -879,6 +883,9 @@ class ServingEngine:
             obs.inc("serve.plan_flips", index_id=reg.index_id)
             obs.set_gauge("serve.plan.epoch", float(new.epoch),
                           index_id=reg.index_id)
+            # flight-recorder trigger: the swap is complete and no
+            # engine lock is held here
+            obs.recorder.note_plan_flip(reg.index_id, int(new.epoch))
 
     def _build_program(self, reg: _Registration, bucket: int, k: int,
                        plan=None) -> Callable:
@@ -1022,6 +1029,10 @@ class ServingEngine:
             obs.inc("serve.batches", index_id=reg.index_id, algo=reg.algo)
             obs.observe("serve.batch_fill", n / bucket)
             obs.observe("serve.batch_rows", float(n))
+            # per-index result coverage (1.0 unless a sharded path
+            # degraded) — the coverage-drop drift detector's input
+            obs.set_gauge("serve.coverage", float(coverage),
+                          index_id=reg.index_id)
             if snap is not None:
                 obs.set_gauge("serve.generation", float(generation),
                               index_id=reg.index_id)

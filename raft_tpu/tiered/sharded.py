@@ -269,6 +269,12 @@ class TieredShardedIndex:
     def _robust_algo(self) -> str:
         return f"tiered_{self.algo}"
 
+    # label under which the tiered.search.* metrics are emitted (a
+    # bounded name: one value per configured algo, never per-call)
+    @property
+    def _search_algo(self) -> str:
+        return f"sharded_{self.algo}"
+
     def _scan(self, queries, kk: int, merge_mode: str, health):
         """Dispatch the sharded scan for ``kk`` global candidates.
         Returns replicated device arrays without syncing."""
@@ -341,7 +347,7 @@ class TieredShardedIndex:
         failed_tiers = set()
 
         if obs.is_enabled():
-            obs.inc("tiered.search.calls", algo=f"sharded_{self.algo}")
+            obs.inc("tiered.search.calls", algo=self._search_algo)
             obs.inc("tiered.search.queries", float(nq))
 
         def consume(i, cand_np):

@@ -1,0 +1,12 @@
+"""Seeded violation: a per-request trace id smuggled into a metric
+*label*. Labels key series (``(name, labels)``), so every request mints
+a fresh series and the registry — and every SeriesBank sampling it —
+grows without bound.
+
+Expected: exactly one ``unbounded-label`` on the marked line.
+"""
+from raft_tpu import obs
+
+
+def count_request(trace_id):
+    obs.inc("serve.requests", index_id=f"req-{trace_id}")  # LINT-HERE

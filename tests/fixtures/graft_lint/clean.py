@@ -223,6 +223,15 @@ def record_dynamic_metric(obs, kind, value):
     obs.inc(name, value)
 
 
+def record_bounded_labels(obs, rid, trace_id, latency_ms):
+    # unbounded-label negative space: enum literals and small-domain ids
+    # (a replica ordinal) are bounded; the observe trace_id keyword is
+    # the exemplar channel, not a label
+    obs.inc("serve.requests", index_id="main", algo="ivf_flat")
+    obs.inc("serve.slow_shards", index_id="main", shard=str(rid))
+    obs.observe("serve.time_in_queue_ms", latency_ms, trace_id=trace_id)
+
+
 def trace_documented_phase(obs, queries):
     # orphan-span negative space: a documented taxonomy name is fine,
     # and dynamic span names are outside the static taxonomy

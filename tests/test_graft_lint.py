@@ -41,6 +41,7 @@ BAD = {
     "bad_metric_drift.py": "metric-drift",
     "bad_fault_point_drift.py": "fault-point-drift",
     "bad_orphan_span.py": "orphan-span",
+    "bad_unbounded_label.py": "unbounded-label",
     "bad_guarded_field.py": "guarded-field",
     "bad_guard_inference.py": "guard-inference",
     "bad_thread_lifecycle.py": "thread-lifecycle",

@@ -73,6 +73,7 @@ def test_new_rules_run_strict_and_clean(project):
     strict = lint_project(project, select=[
         "lock-order", "collective-divergence",
         "metric-drift", "fault-point-drift", "orphan-span",
+        "unbounded-label",
         "guarded-field", "guard-inference", "thread-lifecycle",
         "scattered-auto",
     ])

@@ -55,6 +55,10 @@ _METRICS = {
     # at the same recall floor — 1.0 means the cost models found the
     # measured frontier; regresses by dropping
     "planner_regret": (+1, "absolute", "regret_drop"),
+    # obs_overhead phase column (bench.py): fractional QPS cost of the
+    # always-on recorder + time-series pipeline on the serve row —
+    # regresses by growing (absolute: the fraction itself is the delta)
+    "recorder_overhead_frac": (-1, "absolute", "overhead_rise"),
 }
 
 
@@ -240,6 +244,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--regret-drop", type=float, default=0.05,
                     help="flag absolute planner_regret drops beyond this "
                          "(default 0.05)")
+    ap.add_argument("--overhead-rise", type=float, default=0.02,
+                    help="flag absolute recorder_overhead_frac rises beyond "
+                         "this (default 0.02 — the <2%% overhead contract)")
     ap.add_argument("--ms-floor", type=float, default=0.05,
                     help="ignore p99 deltas when both sides sit under this")
     ap.add_argument("--smoke", action="store_true",

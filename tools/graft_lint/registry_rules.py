@@ -26,10 +26,19 @@ failure:
   reports and Perfetto traces are read by name; an undocumented span
   is a phase nobody can look up.
 
-Both rules locate the repo root by walking up from the linted file to
-a directory containing ``docs/``; files outside any such layout are
-skipped (the rules are about *this* repo's contract, not a general
-property of Python).
+* ``unbounded-label`` — a label *value* passed to an emitter must come
+  from a bounded domain. The registry keys series by ``(name, labels)``
+  (:func:`raft_tpu.obs.metrics._fmt_key`), so a per-request id smuggled
+  into a label — an f-string, a raw ``trace_id``/``row_id``/
+  ``generation`` — mints a fresh series per call and grows the registry
+  (and every ``SeriesBank`` sampling it) without bound. The exemplar
+  channel (``observe(..., trace_id=...)``) is the sanctioned way to
+  attach high-cardinality ids; it is exempt.
+
+The doc-drift rules locate the repo root by walking up from the linted
+file to a directory containing ``docs/``; files outside any such
+layout are skipped (the rules are about *this* repo's contract, not a
+general property of Python).
 """
 from __future__ import annotations
 
@@ -228,4 +237,88 @@ class OrphanSpanChecker(Checker):
                 )
 
 
-CHECKERS = [FaultPointDriftChecker(), MetricDriftChecker(), OrphanSpanChecker()]
+#: identifiers that name per-request / per-row values — a label built
+#: from one of these keys a fresh series per call
+_UNBOUNDED_IDS = frozenset({
+    "trace_id", "trace", "row_id", "rowid", "req_id", "request_id",
+    "generation", "seq", "seqno", "seq_no", "uuid", "guid",
+})
+
+#: builtins that stringify without bounding the domain
+_STRINGIFIERS = frozenset({"str", "repr", "format", "hex"})
+
+
+def _terminal_id(node: ast.AST) -> Optional[str]:
+    """The rightmost identifier of a Name/Attribute chain, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class UnboundedLabelChecker(Checker):
+    rule = "unbounded-label"
+    doc = (
+        "label value passed to obs.inc/observe/set_gauge that is "
+        "per-request (f-string, trace/row/request id, generation) — "
+        "labels key series, so an unbounded value grows the registry "
+        "without bound; use the observe(..., trace_id=...) exemplar "
+        "channel for high-cardinality ids"
+    )
+
+    def _why(self, kw: ast.keyword) -> Optional[str]:
+        v = kw.value
+        if isinstance(v, ast.JoinedStr) and any(
+            isinstance(part, ast.FormattedValue) for part in v.values
+        ):
+            return "an f-string"
+        tid = _terminal_id(v)
+        if tid in _UNBOUNDED_IDS:
+            return f"the per-request id '{tid}'"
+        if isinstance(v, ast.Call):
+            fn = v.func
+            wraps = (
+                isinstance(fn, ast.Name) and fn.id in _STRINGIFIERS
+            ) or (isinstance(fn, ast.Attribute) and fn.attr == "format")
+            if wraps:
+                for arg in list(v.args) + [k.value for k in v.keywords]:
+                    tid = _terminal_id(arg)
+                    if tid in _UNBOUNDED_IDS:
+                        return f"a stringified per-request id '{tid}'"
+        return None
+
+    def check(self, module: LintModule) -> Iterator[Violation]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name not in _EMITTERS:
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue  # **labels: dynamic, out of static scope
+                if name == "observe" and kw.arg == "trace_id":
+                    continue  # the exemplar channel, not a label
+                why = self._why(kw)
+                if why is not None:
+                    yield self.violation(
+                        module, kw.value,
+                        f"label '{kw.arg}' is {why} — labels key "
+                        "series, so a per-request value mints a fresh "
+                        "series every call and grows the registry "
+                        "without bound; use a bounded enum, or the "
+                        "observe(..., trace_id=...) exemplar channel "
+                        "for high-cardinality ids",
+                    )
+
+
+CHECKERS = [
+    FaultPointDriftChecker(),
+    MetricDriftChecker(),
+    OrphanSpanChecker(),
+    UnboundedLabelChecker(),
+]

@@ -206,6 +206,47 @@ def tail_attribution(
     return rows
 
 
+#: robust.breaker.state gauge encoding (see raft_tpu.robust.retry)
+_BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+
+
+def cluster_health_section(
+    gauges: Dict[str, float], health: Optional[Dict[str, Any]] = None
+) -> Optional[str]:
+    """The "cluster health" section: per-target breaker states decoded
+    from the ``robust.breaker.state{target}`` gauges in the artifact,
+    plus the aggregated ``ReplicaGroup.health()["cluster"]`` snapshot
+    when a health dump is supplied (``--health``). Returns None when
+    neither source has anything to say."""
+    parts: List[str] = []
+    if health:
+        cluster = health.get("cluster") or {}
+        if cluster:
+            rows = [[k, f"{v}" if isinstance(v, str) else f"{v:g}"]
+                    for k, v in sorted(cluster.items())]
+            parts.append(_table(rows, ["cluster", "value"]))
+        replicas = health.get("replicas") or []
+        if replicas:
+            rows = [
+                [str(i), str(r.get("breaker", "?")),
+                 f"{r.get('staleness_records', 0):g}",
+                 f"{r.get('queue_rows', 0):g}"]
+                for i, r in enumerate(replicas)
+            ]
+            parts.append(_table(rows, ["replica", "breaker", "staleness",
+                                       "queue"]))
+    breaker_rows = []
+    for key, v in sorted(gauges.items()):
+        if key.startswith("robust.breaker.state"):
+            state = _BREAKER_STATES.get(float(v), f"?{v:g}")
+            breaker_rows.append([key, state])
+    if breaker_rows:
+        parts.append(_table(breaker_rows, ["breaker gauge", "state"]))
+    if not parts:
+        return None
+    return "## cluster health\n" + "\n\n".join(parts)
+
+
 def _table(rows: List[List[str]], header: List[str]) -> str:
     widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
     def fmt(r):
@@ -217,14 +258,17 @@ def _table(rows: List[List[str]], header: List[str]) -> str:
 
 
 def render_report(*paths: str, top: int = 10,
-                  plan_explains: Optional[List[str]] = None) -> str:
+                  plan_explains: Optional[List[str]] = None,
+                  health: Optional[Dict[str, Any]] = None) -> str:
     """Build the text report over one or more obs artifact files.
 
     ``plan_explains`` appends the active query plans' full cost
     breakdowns (``ServingEngine.plan_explain`` /
     ``RegistrationPlan.explain``, see docs/planner.md) as their own
     section, so the report pairs *what dispatched* (the planner metric
-    tables) with *why* (the per-candidate cost terms)."""
+    tables) with *why* (the per-candidate cost terms). ``health`` is a
+    ``ReplicaGroup.health()`` dump (``--health file.json``) rendered as
+    the cluster-health section alongside the breaker-state gauges."""
     spans: List[Dict[str, Any]] = []
     counters: Dict[str, float] = {}
     gauges: Dict[str, float] = {}
@@ -290,6 +334,13 @@ def render_report(*paths: str, top: int = 10,
     if dispatch_rows:
         sections.append("## search dispatch\n"
                         + _table(dispatch_rows, ["counter", "value"]))
+    # cluster health: replica breaker states (decoded from the state
+    # gauges) and, when a health dump rides along, the replica group's
+    # aggregated cluster snapshot — the "is the fleet ok" glance before
+    # any per-metric digging
+    cluster = cluster_health_section(gauges, health)
+    if cluster:
+        sections.append(cluster)
     # robustness + mutability get their own table: fault fires, retries,
     # fallbacks, WAL traffic (records/bytes/rotations), tombstone
     # fraction, generations, compaction backlog/heartbeat and serving
@@ -366,13 +417,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="text file of RegistrationPlan.explain dumps "
                          "(e.g. bench_artifacts/plan_explain.txt) appended "
                          "as the report's plan-explain section")
+    ap.add_argument("--health", metavar="FILE", default=None,
+                    help="JSON dump of ReplicaGroup.health() rendered as "
+                         "the cluster-health section")
     ns = ap.parse_args(argv)
     explains = None
     if ns.plan_explain:
         with open(ns.plan_explain, "r", encoding="utf-8") as f:
             explains = [f.read()]
+    health = None
+    if ns.health:
+        with open(ns.health, "r", encoding="utf-8") as f:
+            health = json.load(f)
     try:
-        print(render_report(*ns.paths, top=ns.top, plan_explains=explains))
+        print(render_report(*ns.paths, top=ns.top, plan_explains=explains,
+                            health=health))
     except (OSError, json.JSONDecodeError, KeyError, ValueError) as e:
         print(f"obs_report: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
