@@ -282,6 +282,7 @@ def _is_pareto_algo(algo):
         and not algo.startswith("serve_")
         and not algo.startswith("sharded_")
         and not algo.startswith("replicated_")
+        and not algo.startswith("control_plane")
     )
 
 
@@ -1694,6 +1695,192 @@ def _bench_main():
         except Exception as e:  # noqa: BLE001
             phase_errors["replicated"] = f"{type(e).__name__}: {e}"[:200]
             print(f"# replicated failed: {phase_errors['replicated']}",
+                  flush=True)
+
+    # ---- control plane: leader-kill failover + SLO autoscale -------------
+    # the robustness claim behind docs/replication.md's control-plane
+    # section, measured: open-loop load through a WAL-replicated mutable
+    # registration while the LEADER is killed and its lease runs out —
+    # a follower promotes (lease CAS + fencing epoch bump) and the row
+    # publishes the unavailability window (kill -> election) plus the
+    # p99 *through* the election. The autoscale row then drives queue
+    # pressure through the SLO-driven autoscaler and re-measures p99 on
+    # the grown fleet. Both rows assert in-bench that every request
+    # completed with zero typed rejects.
+    if over_budget(0.955):
+        print("# control_plane skipped: time budget", flush=True)
+    else:
+        try:
+            import tempfile as _cp_tmp
+
+            from raft_tpu.bench.loadgen import run_open_loop as _cp_loop
+            from raft_tpu.mutable import MutableIndex as _CpMutable
+            from raft_tpu.replica import (
+                AutoscalePolicy as _CpPolicy,
+                ControlPlane as _CpControl,
+                FencedError as _CpFenced,
+                Follower as _CpFollower,
+                LeaseStore as _CpLease,
+                ReplicaGroup as _CpGroup,
+                Replication as _CpRep,
+            )
+
+            cp_smoke = bool(os.environ.get("RAFT_TPU_BENCH_SMOKE"))
+            cp_req = 64 if cp_smoke else 256
+            cp_rate = 2000.0
+            cp_dim = 16
+            rng_cp = np.random.default_rng(7)
+            cp_X = rng_cp.standard_normal((512, cp_dim)).astype(np.float32)
+            cp_Q = rng_cp.standard_normal((64, cp_dim)).astype(np.float32)
+
+            class _CpClock:
+                """Virtual lease clock: the drill decides exactly when
+                the dead leader's lease expires."""
+
+                def __init__(self):
+                    self.t = 0.0
+
+                def __call__(self):
+                    return self.t
+
+                def advance(self, dt):
+                    self.t += dt
+
+            def _cp_pipeline(root):
+                leader = _CpMutable.open(
+                    os.path.join(root, "leader"), "brute_force", cp_dim
+                )
+                leader.insert(cp_X[:384])
+                fol = _CpFollower(
+                    os.path.join(root, "leader"), os.path.join(root, "f0"),
+                    algo="brute_force", dim=cp_dim, name="f0",
+                )
+                rep = _CpRep(leader, [fol], seal_bytes=1)
+                clk = _CpClock()
+                store = _CpLease(
+                    os.path.join(root, "lease"), ttl_s=1.0, clock=clk
+                )
+                cpl = _CpControl(
+                    rep, store, root_dir=os.path.join(root, "cp"), clock=clk
+                )
+                return rep, cpl, clk
+
+            # -- failover drill: kill the leader mid-stream ----------------
+            with _cp_tmp.TemporaryDirectory() as cp_root:
+                rep_cp, cpl, cp_clk = _cp_pipeline(cp_root)
+                grp_cp = _CpGroup(n_replicas=2, name="ctrl")
+                grp_cp.register_mutable_replicated("cp", rep_cp)
+                grp_cp.maintenance_tick()
+
+                class _LeaderKill:
+                    """Engine shim: depose the leader (crash + honest
+                    lease expiry) a third of the way into the stream and
+                    stamp the kill->election unavailability window."""
+
+                    def __init__(self, grp):
+                        self._grp, self._n = grp, 0
+                        self.killed, self.t_kill = False, 0.0
+                        self.t_elected = None
+
+                    def submit(self, *a, **kw):
+                        fut = self._grp.submit(*a, **kw)
+                        self._n += 1
+                        if not self.killed and self._n >= cp_req // 3:
+                            self.killed = True
+                            self.t_kill = time.perf_counter()
+                            cpl.kill_leader()
+                            cp_clk.advance(2.0)
+                        return fut
+
+                    def step(self, force=False):
+                        r = self._grp.step(force=force)
+                        if (self.killed and self.t_elected is None
+                                and cpl.elections):
+                            self.t_elected = time.perf_counter()
+                        return r
+
+                    def run_until_idle(self):
+                        return self._grp.run_until_idle()
+
+                shim = _LeaderKill(grp_cp)
+                repk, _ = _cp_loop(
+                    shim, "cp", cp_Q, K,
+                    rate_qps=cp_rate, n_requests=cp_req, seed=5,
+                )
+                grp_cp.maintenance_tick()  # elect, if the stream drained
+                if shim.t_elected is None and cpl.elections:
+                    shim.t_elected = time.perf_counter()
+                # the failover claims, asserted in-bench: the kill
+                # landed, a follower promoted, every request completed
+                assert shim.killed, "leader kill never armed"
+                assert cpl.elections >= 1, "no follower promoted"
+                assert repk.completed == cp_req and not repk.rejected, (
+                    f"election dropped requests: completed "
+                    f"{repk.completed}/{cp_req}, rejected {repk.rejected}")
+                # every stale-epoch frame is rejected typed
+                fol_cp = rep_cp.followers[0]
+                try:
+                    fol_cp.apply(fol_cp.position.segment,
+                                 fol_cp.position.offset, b"", epoch=1)
+                    raise AssertionError("stale-epoch frame was not fenced")
+                except _CpFenced:
+                    pass
+                unavail_ms = round(
+                    (shim.t_elected - shim.t_kill) * 1e3, 3)
+                krow = {"config": f"open rate={cp_rate:g} kill=leader",
+                        "replicas": 2, "elections": int(cpl.elections),
+                        "unavailability_ms": unavail_ms, **repk.row()}
+                results.setdefault("control_plane_failover", []).append(krow)
+                _rec_add({"algo": "control_plane_failover", **krow})
+                print(f"# control_plane    {krow['config']:<22s}"
+                      f" {krow['qps']:>10} qps"
+                      f"  p99-through-election={krow['p99_ms']:.2f} ms"
+                      f"  unavailability={unavail_ms:.1f} ms"
+                      f"  rej={krow['rejected']}", flush=True)
+
+            # -- autoscale row: queue pressure grows the fleet -------------
+            with _cp_tmp.TemporaryDirectory() as cp_root:
+                rep_as, cpl_as, _ = _cp_pipeline(cp_root)
+                grp_as = _CpGroup(n_replicas=2, name="ctrl-as")
+                grp_as.register_mutable_replicated("cp", rep_as)
+                grp_as.maintenance_tick()
+                # down_ticks effectively off: the row measures the GROWN
+                # fleet, so the light open-loop tail must not shrink it
+                # back mid-measurement
+                grp_as.enable_autoscaler(
+                    _CpPolicy(up_ticks=1, queue_up_rows=8, max_replicas=3,
+                              cooldown_s=0.0, down_ticks=1_000_000),
+                    warm_k={"cp": K},
+                )
+                futs = [grp_as.submit("cp", cp_Q[i % 32:i % 32 + 4], K)
+                        for i in range(24)]
+                grp_as.maintenance_tick()  # queued rows: scale up, warmed
+                grown = grp_as.n_replicas
+                grp_as.run_until_idle()
+                pressure_ok = all(
+                    f.result(0).coverage == 1.0 for f in futs)
+                assert pressure_ok, "queue-pressure requests lost"
+                assert grown == 3, f"autoscaler did not grow: {grown}"
+                grp_as.maintenance_tick()  # converge the new follower
+                rep_a, _ = _cp_loop(
+                    grp_as, "cp", cp_Q, K,
+                    rate_qps=cp_rate, n_requests=cp_req, seed=6,
+                )
+                assert rep_a.completed == cp_req and not rep_a.rejected, (
+                    f"autoscaled fleet dropped requests: "
+                    f"{rep_a.completed}/{cp_req}, rejected {rep_a.rejected}")
+                arow = {"config": f"open rate={cp_rate:g} autoscale",
+                        "replicas": int(grp_as.n_replicas), **rep_a.row()}
+                results.setdefault("control_plane_autoscale", []).append(arow)
+                _rec_add({"algo": "control_plane_autoscale", **arow})
+                print(f"# control_plane    {arow['config']:<22s}"
+                      f" {arow['qps']:>10} qps"
+                      f"  p99={arow['p99_ms']:.2f} ms"
+                      f"  replicas={arow['replicas']}"
+                      f"  rej={arow['rejected']}", flush=True)
+        except Exception as e:  # noqa: BLE001
+            phase_errors["control_plane"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"# control_plane failed: {phase_errors['control_plane']}",
                   flush=True)
 
     # ---- multichip: ring vs gather candidate exchange --------------------

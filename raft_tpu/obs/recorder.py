@@ -64,7 +64,9 @@ BUNDLE_SUFFIX = ".raftbundle"
 
 #: trigger causes an auto-dumping recorder reacts to (``manual`` — an
 #: explicit :func:`dump` call — is always allowed)
-DEFAULT_TRIGGERS = frozenset({"slo", "fault", "breaker", "plan_flip", "worker"})
+DEFAULT_TRIGGERS = frozenset(
+    {"slo", "fault", "breaker", "plan_flip", "worker", "election", "fenced"}
+)
 
 
 @lockcheck.guarded_fields
@@ -208,6 +210,42 @@ class FlightRecorder:
         """A compactor worker died and was restarted by the watchdog."""
         self._record("worker_death", index=index)
         return self._trigger("worker", {"index": index})
+
+    def note_election(
+        self, index_id: str, epoch: int, leader: str, reason: str
+    ) -> Optional[str]:
+        """The control plane elected a new leader (called by
+        :meth:`~raft_tpu.replica.control.ControlPlane.tick` with no
+        tracked lock held — elections run on the maintenance driver).
+        A leader change is always an incident worth a bundle."""
+        self._record(
+            "election", index_id=index_id, epoch=epoch, leader=leader,
+            reason=reason,
+        )
+        return self._trigger(
+            "election",
+            {"index_id": index_id, "epoch": epoch, "leader": leader,
+             "reason": reason},
+        )
+
+    def note_fenced(self, follower: str, epoch: int, fence_epoch: int) -> Optional[str]:
+        """A follower rejected a stale-epoch frame — evidence a deposed
+        leader is still shipping (called from ``Follower.apply``,
+        contractually outside every tracked lock)."""
+        self._record(
+            "fenced", follower=follower, epoch=epoch, fence_epoch=fence_epoch
+        )
+        return self._trigger(
+            "fenced",
+            {"follower": follower, "epoch": epoch, "fence_epoch": fence_epoch},
+        )
+
+    def note_scale(self, group: str, direction: str, n_replicas: int) -> None:
+        """The autoscaler resized a replica group (event only — scaling
+        is routine capacity management, not an incident)."""
+        self._record(
+            "scale", group=group, direction=direction, n_replicas=n_replicas
+        )
 
     def note_anomaly(self, anomaly: timeseries.Anomaly) -> None:
         """A drift detector fired (event only — detectors inform, the
@@ -551,3 +589,21 @@ def note_worker_death(index: str) -> None:
     r = _active
     if r is not None and metrics.is_enabled():
         r.note_worker_death(index)
+
+
+def note_election(index_id: str, epoch: int, leader: str, reason: str) -> None:
+    r = _active
+    if r is not None and metrics.is_enabled():
+        r.note_election(index_id, epoch, leader, reason)
+
+
+def note_fenced(follower: str, epoch: int, fence_epoch: int) -> None:
+    r = _active
+    if r is not None and metrics.is_enabled():
+        r.note_fenced(follower, epoch, fence_epoch)
+
+
+def note_scale(group: str, direction: str, n_replicas: int) -> None:
+    r = _active
+    if r is not None and metrics.is_enabled():
+        r.note_scale(group, direction, n_replicas)

@@ -7,30 +7,59 @@ The pieces (see ``docs/replication.md`` for the full story):
   API, with circuit-breaker health routing and failover that
   **re-queues** in-flight work instead of erroring it;
 * :class:`~raft_tpu.replica.router.Router` — least-queue-depth
-  admission over breaker-closed, staleness-bounded replicas;
+  admission over breaker-closed, staleness-bounded, non-draining
+  replicas;
 * :mod:`~raft_tpu.replica.shipping` — leader WAL seal → CRC-verified
   segment shipping → follower replay, with bounded-staleness
-  accounting (:class:`Replication`, :class:`Shipper`,
-  :class:`Follower`, :class:`ShipRejected`).
+  accounting and per-hop fencing tokens (:class:`Replication`,
+  :class:`Shipper`, :class:`Follower`, :class:`ShipRejected`,
+  :class:`FencedError`);
+* :mod:`~raft_tpu.replica.control` — the control plane: file-CAS
+  lease with epoch counter (:class:`LeaseStore`), highest-cursor
+  leader election with fenced promotion (:class:`ControlPlane`), and
+  SLO-driven fleet sizing (:class:`Autoscaler`,
+  :class:`AutoscalePolicy`);
+* :mod:`~raft_tpu.replica.transport` — the real wire: a length-framed
+  TCP segment server plus the retrying, breaker-gated transport
+  callable (:class:`SegmentServer`, :class:`SocketTransport`,
+  :class:`TransportError`).
 """
+from raft_tpu.replica.control import (
+    Autoscaler,
+    AutoscalePolicy,
+    ControlPlane,
+    Lease,
+    LeaseStore,
+)
 from raft_tpu.replica.group import ReplicaGroup
 from raft_tpu.replica.router import Router
 from raft_tpu.replica.shipping import (
     DEFAULT_CHUNK_BYTES,
+    FencedError,
     Follower,
     FollowerPosition,
     Replication,
     Shipper,
     ShipRejected,
 )
+from raft_tpu.replica.transport import SegmentServer, SocketTransport, TransportError
 
 __all__ = [
     "DEFAULT_CHUNK_BYTES",
+    "Autoscaler",
+    "AutoscalePolicy",
+    "ControlPlane",
+    "FencedError",
     "Follower",
     "FollowerPosition",
+    "Lease",
+    "LeaseStore",
     "ReplicaGroup",
     "Replication",
     "Router",
+    "SegmentServer",
     "ShipRejected",
     "Shipper",
+    "SocketTransport",
+    "TransportError",
 ]

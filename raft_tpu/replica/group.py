@@ -103,11 +103,13 @@ class ReplicaGroup:
         name: str = "replicas",
         maintenance_interval_ms: float = 10.0,
     ):
+        self._engine_factory = engine_factory or (
+            lambda r: ServingEngine(clock=clock)
+        )
         if engines is not None:
             self.engines: List[ServingEngine] = list(engines)
         else:
-            factory = engine_factory or (lambda r: ServingEngine(clock=clock))
-            self.engines = [factory(r) for r in range(int(n_replicas))]
+            self.engines = [self._engine_factory(r) for r in range(int(n_replicas))]
         expects(len(self.engines) >= 1, "a replica group needs >= 1 engine")
         self.name = str(name)
         self.n_replicas = len(self.engines)
@@ -133,6 +135,19 @@ class ReplicaGroup:
         self._lock = lockcheck.tracked(threading.RLock(), "replica.group")
         self._flights: List[_Flight] = []
         self._parked: List[_Flight] = []
+        #: how to rebuild each registration on a freshly provisioned
+        #: replica (autoscale-up) or after a control-plane promotion
+        #: swapped the serving handles — ("immutable", (algo, index,
+        #: kwargs)) or ("replicated", kwargs), plus declared SLOs
+        self._registrations: Dict[str, tuple] = {}
+        self._slo_kwargs: Dict[str, dict] = {}
+        # autoscaler state: owned by the maintenance driver (thread 0 in
+        # threaded mode, the stepping thread otherwise) — single-owner,
+        # like _threads
+        self._autoscaler = None
+        self._warm_k: Dict[str, int] = {}
+        self._draining_rid: Optional[int] = None
+        self._pump_interval_s = 0.0005
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
 
@@ -158,6 +173,12 @@ class ReplicaGroup:
         )
         for eng, idx in zip(self.engines, per_replica):
             eng.register(index_id, algo, idx, **kwargs)
+        with self._lock:
+            # immutable structures are safe to share: a scaled-up
+            # replica re-registers the first copy
+            self._registrations[index_id] = (
+                "immutable", (algo, per_replica[0], dict(kwargs))
+            )
 
     def register_mutable_replicated(self, index_id: str, replication, **kwargs) -> None:
         """Register a WAL-shipped mutable replication pipeline: the
@@ -179,6 +200,7 @@ class ReplicaGroup:
             eng.register_mutable(index_id, idx, **kwargs)
         with self._lock:
             self._replications[index_id] = replication
+            self._registrations[index_id] = ("replicated", dict(kwargs))
 
     def registered(self) -> List[str]:
         return self.engines[0].registered()
@@ -460,14 +482,191 @@ class ReplicaGroup:
 
     def maintenance_tick(self) -> None:
         """Drive every replication pipeline one cycle (leader seal →
-        ship sealed frames → follower replay) and publish follower lag
-        to the router's admission floor."""
+        ship sealed frames → follower replay — and, when a control
+        plane is attached, its renew-or-elect pass), re-register
+        engines when a promotion swapped the serving handles, publish
+        follower lag to the router's admission floor, and run one
+        autoscaler decision."""
+        with self._lock:
+            replications = list(self._replications.items())
+        for index_id, replication in replications:
+            replication.tick()
+            take = getattr(replication, "take_handles_changed", None)
+            if take is not None and take():
+                self._reregister(index_id, replication)
+            for j in range(len(replication.followers)):
+                self.router.set_staleness(j + 1, replication.staleness(j))
+        self._autoscale_step()
+
+    def _reregister(self, index_id: str, replication) -> None:
+        """A control-plane promotion (or resize) swapped the
+        replication's serving handles: point every engine at the new
+        ones. Same-length zip by construction — promotions conserve the
+        replica count; a mid-resize mismatch self-heals next tick."""
+        with self._lock:
+            reg = self._registrations.get(index_id)
+        kwargs = reg[1] if reg is not None and reg[0] == "replicated" else {}
+        for eng, idx in zip(list(self.engines), replication.indexes()):
+            eng.register_mutable(index_id, idx, **kwargs)
+
+    # -- SLO-driven autoscaling --------------------------------------------
+
+    def enable_autoscaler(
+        self,
+        policy,
+        *,
+        warm_k: Optional[Dict[str, int]] = None,
+        autoscaler=None,
+    ) -> None:
+        """Arm SLO-driven fleet sizing: every maintenance tick feeds the
+        worst fast-window burn rate (across replica 0's SLOs) and the
+        group-wide queue depth into an :class:`~raft_tpu.replica.
+        control.Autoscaler`, and acts on its advice — grow with a
+        warmed-up replica, or drain-then-retire the highest one.
+
+        ``policy`` is an :class:`~raft_tpu.replica.control.
+        AutoscalePolicy` (ignored when a prebuilt ``autoscaler`` is
+        passed). ``warm_k`` maps index ids to the ``k`` each new
+        replica precompiles (:meth:`ServingEngine.warmup` →
+        ``ProgramCache.warmup``) *before* it takes traffic."""
+        if autoscaler is None:
+            from raft_tpu.replica.control import Autoscaler
+
+            autoscaler = Autoscaler(policy, clock=self._clock)
+        self._warm_k = dict(warm_k or {})
+        self._autoscaler = autoscaler
+
+    def _autoscale_step(self) -> None:
+        """One sizing decision per maintenance tick. A drain in
+        progress preempts new decisions — the fleet finishes one
+        resize before considering the next."""
+        a = self._autoscaler
+        if a is None:
+            return
+        if self._draining_rid is not None:
+            self._drain_step()
+            return
+        eng0 = self.engines[0]
+        burn = 0.0
+        for iid in eng0.registered():
+            b = eng0.slo_burn(iid)
+            if b is not None:
+                burn = max(burn, b)
+        decision = a.decide(
+            burn=burn, queue_rows=self.queue_depth(),
+            n_replicas=self.n_replicas, now=self._clock(),
+        )
+        if decision > 0:
+            self._scale_up()
+        elif decision < 0 and self.n_replicas >= 2:
+            self._begin_drain()
+
+    def _provision_engine(self, rid: int):
+        """Build a fresh engine carrying every current registration
+        (replicated ones grow their pipeline by one follower via the
+        control plane). Returns None when any registration cannot be
+        reproduced — a partially registered replica must never join
+        the routable set."""
+        eng = self._engine_factory(rid)
+        with self._lock:
+            regs = dict(self._registrations)
+            replications = dict(self._replications)
+            slos = {k: dict(v) for k, v in self._slo_kwargs.items()}
+        for index_id, (kind, payload) in regs.items():
+            if kind == "replicated":
+                replication = replications.get(index_id)
+                controller = getattr(replication, "controller", None)
+                if controller is None:
+                    return None  # no control plane: cannot mint a follower
+                follower = controller.add_follower()
+                eng.register_mutable(index_id, follower.index, **payload)
+                # consumed: this path registered the new handle itself
+                replication.take_handles_changed()
+            else:
+                algo, idx, kwargs = payload
+                eng.register(index_id, algo, idx, **kwargs)
+        for index_id, kwargs in slos.items():
+            eng.set_slo(index_id, **kwargs)
+        return eng
+
+    def _scale_up(self) -> None:
+        rid = self.n_replicas
+        eng = self._provision_engine(rid)
+        if eng is None:
+            return
+        # warm BEFORE the replica is routable: precompile each declared
+        # (index, k) so the first real request never pays an XLA compile
+        for index_id, k in self._warm_k.items():
+            try:
+                eng.warmup(index_id, int(k), run=True)
+            except Exception as e:
+                obs.inc("replica.control.errors", kind=type(e).__name__)
+        # publish the new follower's true lag before admission opens, so
+        # the staleness floor keeps reads off it until it catches up
+        lag = 0
         with self._lock:
             replications = list(self._replications.values())
         for replication in replications:
-            replication.tick()
-            for j in range(len(replication.followers)):
-                self.router.set_staleness(j + 1, replication.staleness(j))
+            n_f = len(replication.followers)
+            if n_f:
+                lag = max(lag, replication.staleness(n_f - 1))
+        self.engines = self.engines + [eng]
+        self.router.add_replica()
+        self.router.set_staleness(rid, lag)
+        self.n_replicas = len(self.engines)
+        if self._threads:
+            t = threading.Thread(
+                target=self._pump_loop, args=(rid, self._pump_interval_s),
+                name=f"{self.name}-pump{rid}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+        obs.inc("serve.autoscale", direction="up")
+        obs.recorder.note_scale(self.name, "up", self.n_replicas)
+
+    def _begin_drain(self) -> None:
+        """Start retiring the highest replica: stop admitting onto it,
+        keep pumping until its queue and flights empty (never replica 0
+        — it serves every replication's leader)."""
+        rid = self.n_replicas - 1
+        if rid == 0:
+            return
+        self._draining_rid = rid
+        self.router.set_draining(rid, True)
+
+    def _drain_step(self) -> None:
+        rid = self._draining_rid
+        if rid is None:
+            return
+        with self._lock:
+            busy = any(fl.replica == rid for fl in self._flights) or any(
+                fl.replica == rid for fl in self._parked
+            )
+        if busy or self.engines[rid].queue_depth() > 0:
+            return  # in-flight work still draining; decide again next tick
+        self._retire(rid)
+
+    def _retire(self, rid: int) -> None:
+        eng = self.engines[rid]
+        with self._lock:
+            replications = list(self._replications.values())
+        for replication in replications:
+            controller = getattr(replication, "controller", None)
+            if controller is not None and len(replication.followers) >= 2:
+                controller.remove_follower()
+                replication.take_handles_changed()  # handles only shrank
+        # shrink the routable set first so the retiring pump thread
+        # (which exits once rid >= n_replicas) can be joined
+        self.n_replicas = rid
+        if self._threads:
+            t = self._threads.pop()
+            t.join(timeout=5.0)
+        self.engines = self.engines[:-1]
+        self.router.remove_last()
+        eng.shutdown(wait=True)
+        self._draining_rid = None
+        obs.inc("serve.autoscale", direction="down")
+        obs.recorder.note_scale(self.name, "down", self.n_replicas)
 
     def health(self) -> Dict[str, object]:
         """Group health: per-replica breaker/queue/staleness plus the
@@ -480,13 +679,17 @@ class ReplicaGroup:
             parked = len(self._parked)
         states = self.router.states()
         replicas = []
-        for rid, eng in enumerate(self.engines):
+        # snapshot the engine list and clip to the router's view so a
+        # concurrent autoscale resize can't index past either side
+        engines = list(self.engines)[: len(states)]
+        for rid, eng in enumerate(engines):
             breaker = self.router.breaker(rid)
             replicas.append({
                 "breaker": states[rid],
                 "consecutive_failures": breaker.failures,
                 "queue_rows": eng.queue_depth(),
                 "staleness_records": self.router.staleness(rid),
+                "draining": self.router.draining(rid),
                 "engine": eng.health(),
             })
         severity = {"closed": 0, "half_open": 1, "open": 2}
@@ -518,7 +721,10 @@ class ReplicaGroup:
         return [eng.warmup(index_id, k, run=run) for eng in self.engines]
 
     def set_slo(self, index_id: str, **kwargs):
-        """Declare the same SLO on every replica; returns the trackers."""
+        """Declare the same SLO on every replica; returns the trackers.
+        Remembered, so an autoscaled replica gets the same objective."""
+        with self._lock:
+            self._slo_kwargs[index_id] = dict(kwargs)
         return [eng.set_slo(index_id, **kwargs) for eng in self.engines]
 
     def shutdown(self, wait: bool = True) -> None:
@@ -536,6 +742,7 @@ class ReplicaGroup:
         if self._threads:
             return
         self._stop.clear()
+        self._pump_interval_s = float(interval_s)
         for rid in range(self.n_replicas):
             t = threading.Thread(
                 target=self._pump_loop, args=(rid, float(interval_s)),
@@ -555,7 +762,9 @@ class ReplicaGroup:
         self._threads = []
 
     def _pump_loop(self, rid: int, interval_s: float) -> None:
-        while not self._stop.is_set():
+        # the loop also exits when its replica is retired (autoscale
+        # scale-down shrinks n_replicas, then joins this thread)
+        while not self._stop.is_set() and rid < self.n_replicas:
             try:
                 self._pump_replica(rid, force=True)
                 if rid == 0:

@@ -60,19 +60,62 @@ class Router:
         #: admission floor: a replica further behind the leader than
         #: this many WAL records takes no new requests (None = no floor)
         self.max_staleness_records = max_staleness_records
+        self._failure_threshold = int(failure_threshold)
+        self._reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
         self._breakers = [
-            CircuitBreaker(
-                f"replica{r}",
-                failure_threshold=failure_threshold,
-                reset_timeout_s=reset_timeout_s,
-                clock=clock,
-            )
-            for r in range(self.n_replicas)
+            self._mk_breaker(r) for r in range(self.n_replicas)
         ]
-        # guards the staleness array only; nothing (locks, obs, faults,
-        # engines) is ever called while it is held — an edge-free leaf
+        # guards the staleness array and the draining set only; nothing
+        # (locks, obs, faults, engines) is ever called while it is held
+        # — an edge-free leaf
         self._lock = lockcheck.tracked(threading.Lock(), "replica.router")
         self._staleness = [0] * self.n_replicas
+        self._draining: set = set()
+
+    def _mk_breaker(self, r: int) -> CircuitBreaker:
+        return CircuitBreaker(
+            f"replica{r}",
+            failure_threshold=self._failure_threshold,
+            reset_timeout_s=self._reset_timeout_s,
+            clock=self._clock,
+        )
+
+    # -- dynamic resize (autoscaler) ----------------------------------------
+
+    def add_replica(self) -> int:
+        """Grow by one replica (fresh breaker, zero staleness); returns
+        its id. Lists are replaced whole so concurrent readers see
+        either the old set or the new one, never a half-grown state."""
+        rid = self.n_replicas
+        self._breakers = self._breakers + [self._mk_breaker(rid)]
+        with self._lock:
+            self._staleness = self._staleness + [0]
+        self.n_replicas = rid + 1
+        return rid
+
+    def remove_last(self) -> None:
+        """Retire the highest-id replica (the group drained it first)."""
+        expects(self.n_replicas >= 2, "cannot retire the last replica")
+        rid = self.n_replicas - 1
+        self.n_replicas = rid
+        self._breakers = self._breakers[:-1]
+        with self._lock:
+            self._staleness = self._staleness[:-1]
+            self._draining.discard(rid)
+
+    def set_draining(self, replica: int, draining: bool = True) -> None:
+        """Mark a replica draining: it finishes in-flight work but
+        admits nothing new (the scale-down prelude)."""
+        with self._lock:
+            if draining:
+                self._draining.add(int(replica))
+            else:
+                self._draining.discard(int(replica))
+
+    def draining(self, replica: int) -> bool:
+        with self._lock:
+            return int(replica) in self._draining
 
     # -- health inputs -----------------------------------------------------
 
@@ -81,13 +124,16 @@ class Router:
 
     def set_staleness(self, replica: int, records: int) -> None:
         """Publish replica lag (WAL records behind the leader; the
-        leader itself stays 0). Fed by the replication maintenance tick."""
+        leader itself stays 0). Fed by the replication maintenance
+        tick; an id beyond the current size (resize in flight) is
+        dropped — the next tick republishes."""
         with self._lock:
-            self._staleness[replica] = int(records)
+            if replica < len(self._staleness):
+                self._staleness[replica] = int(records)
 
     def staleness(self, replica: int) -> int:
         with self._lock:
-            return self._staleness[replica]
+            return self._staleness[replica] if replica < len(self._staleness) else 0
 
     # -- the routing decision ----------------------------------------------
 
@@ -95,22 +141,28 @@ class Router:
         """May NEW work be admitted on ``replica`` right now? (The
         half-open probe is the pump's business, not the caller's — see
         :meth:`~raft_tpu.robust.retry.CircuitBreaker.allow`.)"""
-        if self._breakers[replica].state != CircuitBreaker.CLOSED:
+        breakers = self._breakers
+        if replica >= len(breakers):
+            return False  # resize in flight: not admissible until grown
+        if breakers[replica].state != CircuitBreaker.CLOSED:
             return False
+        with self._lock:
+            if replica in self._draining:
+                return False
+            lag = self._staleness[replica]
         if self.max_staleness_records is None:
             return True
-        with self._lock:
-            lag = self._staleness[replica]
         return lag <= self.max_staleness_records
 
     def pick(self, depths: Sequence[int], exclude: Set[int] = frozenset()) -> Optional[int]:
         """The replica to admit one request on: least ``depths`` entry
         among admissible replicas not in ``exclude`` (lowest id breaks
-        ties); ``None`` when no replica qualifies."""
-        expects(len(depths) == self.n_replicas, "need one depth per replica")
+        ties); ``None`` when no replica qualifies. ``depths`` may
+        briefly disagree with ``n_replicas`` while the autoscaler is
+        resizing — only the common prefix is considered."""
         best: Optional[int] = None
         best_depth = 0
-        for r in range(self.n_replicas):
+        for r in range(min(self.n_replicas, len(depths))):
             if r in exclude or not self.admissible(r):
                 continue
             d = int(depths[r])

@@ -54,6 +54,7 @@ from typing import Callable, List, Optional, Tuple
 
 from raft_tpu import obs
 from raft_tpu.core.errors import RaftError, expects
+from raft_tpu.obs import recorder
 from raft_tpu.mutable import manifest as man
 from raft_tpu.mutable.segments import MutableIndex, _load_main, _load_rows
 from raft_tpu.mutable.wal import _HEADER, _REC_MAGIC, WalRecord, WriteAheadLog
@@ -77,6 +78,21 @@ class ShipRejected(RaftError):
         super().__init__(msg)
         self.segment = int(segment)
         self.offset = int(offset)
+
+
+class FencedError(RaftError):
+    """A shipped chunk carried a stale fencing token: the sender's
+    lease epoch is below the follower's fence. This is NOT a transport
+    or verification failure — the bytes may be pristine — it is a
+    *deposed leader* still shipping. Deliberately not a subclass of
+    :class:`ShipRejected`: re-requesting the same bytes can never help,
+    so the shipper must not retry; the error propagates to the tick,
+    where it is counted and the stale pipeline stays parked."""
+
+    def __init__(self, msg: str, *, epoch: int, fence_epoch: int):
+        super().__init__(msg)
+        self.epoch = int(epoch)
+        self.fence_epoch = int(fence_epoch)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,7 +166,19 @@ class Follower:
         self.position = FollowerPosition(
             generation=-1, segment=0, offset=0, applied_records=0
         )
+        #: fencing high-water mark: the highest lease epoch this
+        #: follower has accepted a frame under (0 = unfenced — every
+        #: non-control-plane pipeline ships at epoch 0 and is accepted).
+        #: Single-owner like ``position`` (the shipping tick), so no lock.
+        self.fence_epoch = 0
         self.sync_generation()
+
+    def fence(self, epoch: int) -> None:
+        """Raise the fencing floor: frames stamped with a lease epoch
+        below ``epoch`` are rejected typed from now on (a deposed
+        leader's ship can no longer advance this follower). Monotonic —
+        fencing never lowers."""
+        self.fence_epoch = max(self.fence_epoch, int(epoch))
 
     # -- generation management ---------------------------------------------
 
@@ -248,7 +276,7 @@ class Follower:
 
     # -- the apply path ----------------------------------------------------
 
-    def apply(self, segment: int, offset: int, data: bytes) -> int:
+    def apply(self, segment: int, offset: int, data: bytes, *, epoch: int = 0) -> int:
         """Verify and apply one shipped chunk.
 
         Every frame is checked (magic, length, CRC, payload decode)
@@ -260,8 +288,26 @@ class Follower:
         re-ships next call); a damaged frame raises
         :class:`ShipRejected` at the clean-prefix offset AFTER the
         clean prefix was applied, so the shipper re-requests only the
-        damaged bytes. Returns bytes consumed."""
+        damaged bytes. Returns bytes consumed.
+
+        ``epoch`` is the sender's fencing token (its lease epoch at
+        ship time). A token below :attr:`fence_epoch` raises
+        :class:`FencedError` before a single byte is considered — a
+        deposed leader cannot corrupt a follower, however valid its
+        frames. A token *above* the fence advances it: followers learn
+        a new leadership regime from the frames themselves."""
         faults.fire("replica.apply", follower=self.name, segment=segment)
+        epoch = int(epoch)
+        if epoch < self.fence_epoch:
+            obs.inc("replica.fenced_frames", follower=self.name)
+            recorder.note_fenced(self.name, epoch, self.fence_epoch)
+            raise FencedError(
+                f"follower {self.name!r} fenced at epoch {self.fence_epoch} "
+                f"rejected a frame stamped epoch {epoch} (deposed sender)",
+                epoch=epoch, fence_epoch=self.fence_epoch,
+            )
+        if epoch > self.fence_epoch:
+            self.fence_epoch = epoch
         pos = self.position
         expects(segment == pos.segment,
                 "chunk for segment %d but follower is at segment %d",
@@ -378,6 +424,11 @@ class Shipper:
     transfer; a rejected chunk (CRC damage in flight) is **re-requested
     from the follower's clean-prefix offset** up to ``max_retries``
     times per segment before the error propagates to the tick.
+
+    ``epoch_source`` is the control plane's fencing hook: a callable
+    returning the sender's *current* lease epoch, read per chunk so the
+    token is fresh at every seal→ship→apply hop. Without one, chunks
+    ship at epoch 0 (the unfenced, pre-control-plane protocol).
     """
 
     def __init__(
@@ -388,12 +439,14 @@ class Shipper:
         transport: Optional[Callable[[str, int, int], bytes]] = None,
         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
         max_retries: int = 2,
+        epoch_source: Optional[Callable[[], int]] = None,
     ):
         self._wal_source = wal_source
         self.follower = follower
         self.transport = transport if transport is not None else _read_file_chunk
         self.chunk_bytes = int(chunk_bytes)
         self.max_retries = int(max_retries)
+        self.epoch_source = epoch_source
 
     def _wal(self) -> WriteAheadLog:
         w = self._wal_source
@@ -426,8 +479,9 @@ class Shipper:
             if obs.is_enabled():
                 obs.inc("replica.ship.bytes", float(len(data)),
                         follower=self.follower.name)
+            epoch = int(self.epoch_source()) if self.epoch_source is not None else 0
             try:
-                consumed = self.follower.apply(sq, pos.offset, data)
+                consumed = self.follower.apply(sq, pos.offset, data, epoch=epoch)
             except ShipRejected:
                 rejections += 1
                 if rejections > self.max_retries:
@@ -475,6 +529,7 @@ class Replication:
         transports: Optional[List[Optional[Callable]]] = None,
         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
         max_retries: int = 2,
+        epoch_source: Optional[Callable[[], int]] = None,
     ):
         expects(leader.directory is not None and leader.wal is not None,
                 "replication needs a directory-backed (WAL-carrying) leader")
@@ -483,21 +538,98 @@ class Replication:
         self.leader = leader
         self.followers = list(followers)
         self.seal_bytes = int(seal_bytes)
+        self._chunk_bytes = int(chunk_bytes)
+        self._max_retries = int(max_retries)
+        #: the fencing token source every shipper stamps chunks with —
+        #: a :class:`~raft_tpu.replica.control.ControlPlane` points this
+        #: at its lease epoch; None ships at epoch 0 (unfenced)
+        self.epoch_source = epoch_source
+        #: attached control plane (lease/election coordinator) — ticked
+        #: first on every :meth:`tick` when present
+        self.controller = None
+        #: False while the leader is known dead and no successor has
+        #: been elected yet: the pipeline parks (no seal, no ship)
+        #: instead of pumping a corpse's WAL
+        self.active = True
+        self._handles_changed = False
         if transports is None:
             transports = [None] * len(self.followers)
-        self.shippers = [
-            Shipper(
-                lambda: self.leader.wal, f,
-                transport=t, chunk_bytes=chunk_bytes, max_retries=max_retries,
-            )
-            for f, t in zip(self.followers, transports)
-        ]
+        self._transports = list(transports)
+        self.shippers = [self._mk_shipper(f, t)
+                         for f, t in zip(self.followers, self._transports)]
+
+    def _mk_shipper(self, f: Follower, t: Optional[Callable]) -> Shipper:
+        return Shipper(
+            lambda: self.leader.wal, f,
+            transport=t, chunk_bytes=self._chunk_bytes,
+            max_retries=self._max_retries, epoch_source=self._epoch,
+        )
+
+    def _epoch(self) -> int:
+        src = self.epoch_source
+        return int(src()) if src is not None else 0
+
+    # -- control-plane reconfiguration --------------------------------------
+
+    def replace(
+        self,
+        leader: MutableIndex,
+        followers: List[Follower],
+        *,
+        transports: Optional[List[Optional[Callable]]] = None,
+    ) -> None:
+        """Swap in a whole new leader + follower set (what a promotion
+        builds) and rebuild the shippers. Serving handles changed:
+        :meth:`take_handles_changed` tells the replica group to
+        re-register every engine."""
+        expects(leader.directory is not None and leader.wal is not None,
+                "replication needs a directory-backed (WAL-carrying) leader")
+        expects(len(followers) >= 1, "replication needs at least one follower")
+        if transports is None:
+            transports = [None] * len(followers)
+        self.leader = leader
+        self.followers = list(followers)
+        self._transports = list(transports)
+        self.shippers = [self._mk_shipper(f, t)
+                         for f, t in zip(self.followers, self._transports)]
+        self.active = True
+        self._handles_changed = True
+
+    def add_follower(self, follower: Follower, transport: Optional[Callable] = None) -> None:
+        """Grow the pipeline by one follower (replica scale-up)."""
+        self.followers = self.followers + [follower]
+        self._transports = self._transports + [transport]
+        self.shippers = self.shippers + [self._mk_shipper(follower, transport)]
+        self._handles_changed = True
+
+    def remove_follower(self) -> Follower:
+        """Retire the last follower (replica scale-down); the caller
+        has already drained its replica."""
+        expects(len(self.followers) >= 2,
+                "cannot retire the last follower of a replication")
+        f = self.followers[-1]
+        self.followers = self.followers[:-1]
+        self._transports = self._transports[:-1]
+        self.shippers = self.shippers[:-1]
+        self._handles_changed = True
+        return f
+
+    def take_handles_changed(self) -> bool:
+        """True exactly once after a reconfiguration changed
+        :meth:`indexes` — the group's cue to re-register engines."""
+        changed, self._handles_changed = self._handles_changed, False
+        return changed
 
     def tick(self) -> int:
         """One seal → ship → publish cycle; returns records applied
         across followers. A follower whose ship fails this tick keeps
-        its clean prefix and retries next tick — the error is counted,
-        never raised into the serving loop."""
+        its clean prefix and retries next tick — the error (transport,
+        verification, or a stale fencing token) is counted, never
+        raised into the serving loop."""
+        if self.controller is not None:
+            self.controller.tick()
+        if not self.active:
+            return 0
         for f in self.followers:
             f.sync_generation()
         wal = self.leader.wal
@@ -507,7 +639,7 @@ class Replication:
         for f, sh in zip(self.followers, self.shippers):
             try:
                 applied += sh.ship()
-            except (ShipRejected, OSError) as e:
+            except (ShipRejected, FencedError, OSError) as e:
                 obs.inc("replica.ship.errors", follower=f.name,
                         kind=type(e).__name__)
         if obs.is_enabled():

@@ -89,6 +89,10 @@ FAULT_POINTS = (
     "wal.ship",               # replica/shipping.py sealed-frame transfer to a follower
     "replica.apply",          # replica/shipping.py follower replay of a shipped chunk
     "recorder.dump",          # obs/recorder.py mid-bundle-write (torn-dump drill)
+    "lease.acquire",          # replica/control.py lease CAS attempt (election)
+    "lease.renew",            # replica/control.py leader heartbeat renewal
+    "transport.read",         # replica/transport.py socket chunk fetch
+    "election.promote",       # replica/control.py follower promotion (pre-CAS)
 )
 
 

@@ -609,6 +609,15 @@ class ServingEngine:
         self._slos[index_id] = tracker
         return tracker
 
+    def slo_burn(self, index_id: str) -> Optional[float]:
+        """The index's fast-window SLO burn rate right now (None when
+        no SLO is declared) — the scalar the replica autoscaler feeds
+        its scale-up threshold."""
+        tracker = self._slos.get(index_id)
+        if tracker is None:
+            return None
+        return tracker.evaluate().burn_fast
+
     def health(self) -> Dict[str, object]:
         """Structured health snapshot: queue + cache pressure, span-drop
         signal, and per-index registration state with SLO budget/burn

@@ -1,0 +1,719 @@
+"""raft_tpu.replica.control + transport — the control plane (CPU).
+
+Lease CAS semantics (one winner per epoch, live lease governs, expiry
+is never renewable), the election/promotion rule (highest shipped
+cursor wins; promotion conserves the replica count and fences every
+slot), fencing-token rejection (a deposed leader's frames raise typed
+``FencedError``, never corrupt a follower), the four control-plane
+chaos seams (``lease.acquire``, ``lease.renew``, ``election.promote``,
+``transport.read``), the socket transport's failure matrix (mangled
+content → follower's ``ShipRejected`` re-request; torn wire / reset /
+slow peer → typed retry/timeout, never a hang; breaker-open fast
+fail; path traversal refused), the autoscaler's hysteresis, and the
+bundle report's control-plane section.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.mutable import MutableIndex
+from raft_tpu.replica import (
+    AutoscalePolicy,
+    Autoscaler,
+    ControlPlane,
+    FencedError,
+    Follower,
+    LeaseStore,
+    Replication,
+    SegmentServer,
+    ShipRejected,
+    SocketTransport,
+    TransportError,
+)
+from raft_tpu.replica.shipping import _read_file_chunk
+from raft_tpu.robust import faults
+from raft_tpu.robust.retry import CircuitBreaker, RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _pristine_gates():
+    faults.disable()
+    faults.clear()
+    obs.disable()
+    obs.registry().reset()
+    yield
+    faults.disable()
+    faults.clear()
+    obs.disable()
+    obs.registry().reset()
+
+
+@pytest.fixture
+def control_obs():
+    reg = obs.registry()
+    reg.reset()
+    obs.enable()
+    yield reg
+    obs.disable()
+    reg.reset()
+
+
+class VClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(19)
+    X = rng.standard_normal((128, 12)).astype(np.float32)
+    Q = rng.standard_normal((16, 12)).astype(np.float32)
+    return X, Q
+
+
+def _mk_leader(tmp_path, X, n=96):
+    leader = MutableIndex.open(str(tmp_path / "leader"), "brute_force", X.shape[1])
+    leader.insert(X[:n])
+    return leader
+
+
+def _mk_follower(tmp_path, dim, name="f0"):
+    return Follower(
+        str(tmp_path / "leader"), str(tmp_path / name),
+        algo="brute_force", dim=dim, name=name,
+    )
+
+
+def _same_rows(a, b):
+    """Live rows of two mutable indexes are identical (order-free)."""
+    ia, va = a.live_rows()
+    ib, vb = b.live_rows()
+    oa, ob = np.argsort(ia), np.argsort(ib)
+    return np.array_equal(ia[oa], ib[ob]) and np.array_equal(va[oa], vb[ob])
+
+
+# ---------------------------------------------------------------------------
+# LeaseStore: the file CAS
+# ---------------------------------------------------------------------------
+
+
+class TestLeaseStore:
+    def test_acquire_grants_epoch_1_and_caches(self, tmp_path):
+        clk = VClock()
+        s = LeaseStore(str(tmp_path / "l"), ttl_s=1.0, clock=clk)
+        assert s.current() is None and s.epoch() == 0
+        lease = s.acquire("a")
+        assert lease is not None
+        assert (lease.holder, lease.epoch) == ("a", 1)
+        assert lease.expires_s == pytest.approx(1.0)
+        assert s.cached() == lease
+        assert s.current() == lease  # durable, not just cached
+
+    def test_live_lease_blocks_a_foreign_acquire(self, tmp_path):
+        clk = VClock()
+        s = LeaseStore(str(tmp_path / "l"), ttl_s=1.0, clock=clk)
+        assert s.acquire("a") is not None
+        assert s.acquire("b") is None  # a's live lease governs
+        clk.advance(2.0)
+        lease = s.acquire("b")  # expiry opens the door, epoch bumps
+        assert lease is not None and (lease.holder, lease.epoch) == ("b", 2)
+
+    def test_cas_one_winner_per_epoch(self, tmp_path):
+        """Two stores racing the same directory: exactly one acquire
+        wins each epoch (the os.link CAS), the loser gets None."""
+        clk = VClock()
+        s1 = LeaseStore(str(tmp_path / "l"), ttl_s=1.0, clock=clk)
+        s2 = LeaseStore(str(tmp_path / "l"), ttl_s=1.0, clock=clk)
+        # both see "no lease" and contend for epoch 1: force the race by
+        # pre-linking epoch 1 from s2 between s1's read and link — the
+        # deterministic stand-in is simply sequential acquires
+        a = s1.acquire("a")
+        b = s2.acquire("b")
+        assert a is not None and b is None
+        # a holder re-acquiring its own expired lease also bumps epoch
+        clk.advance(2.0)
+        again = s1.acquire("a")
+        assert again is not None and again.epoch == 2
+
+    def test_renew_extends_live_refuses_expired_and_deposed(self, tmp_path):
+        clk = VClock()
+        s = LeaseStore(str(tmp_path / "l"), ttl_s=1.0, clock=clk)
+        s.acquire("a")
+        clk.advance(0.6)
+        renewed = s.renew("a")
+        assert renewed is not None
+        assert renewed.epoch == 1  # renewal is same-regime
+        assert renewed.expires_s == pytest.approx(1.6)
+        assert s.renew("b") is None  # not the holder
+        clk.advance(2.0)
+        # expired: renewal must fail — the epoch has to advance through
+        # a fresh acquire or fencing would be unsound
+        assert s.renew("a") is None
+        lease = s.acquire("a")
+        assert lease is not None and lease.epoch == 2
+
+    def test_release_lets_a_successor_in_immediately(self, tmp_path):
+        clk = VClock()
+        s = LeaseStore(str(tmp_path / "l"), ttl_s=100.0, clock=clk)
+        s.acquire("a")
+        assert s.acquire("b") is None
+        assert s.release("a") is True
+        lease = s.acquire("b")  # no ttl wait needed
+        assert lease is not None and lease.epoch == 2
+        assert s.release("a") is False  # no longer governs
+
+    def test_lease_file_is_always_complete_json(self, tmp_path):
+        clk = VClock()
+        s = LeaseStore(str(tmp_path / "l"), ttl_s=1.0, clock=clk)
+        s.acquire("a")
+        s.renew("a", now=0.5)
+        # a second store (another process) reads the same truth
+        s2 = LeaseStore(str(tmp_path / "l"), ttl_s=1.0, clock=clk)
+        cur = s2.current()
+        assert cur is not None and cur.holder == "a"
+        assert cur.expires_s == pytest.approx(1.5)
+
+    def test_lease_seams_fire_typed(self, tmp_path):
+        clk = VClock()
+        s = LeaseStore(str(tmp_path / "l"), ttl_s=1.0, clock=clk)
+        with faults.injected("lease.acquire", error=OSError("store down")):
+            with pytest.raises(OSError):
+                s.acquire("a")
+        assert s.current() is None  # the seam fires before any I/O
+        s.acquire("a")
+        with faults.injected("lease.renew", error=OSError("store down")):
+            with pytest.raises(OSError):
+                s.renew("a")
+        assert s.current().expires_s == pytest.approx(1.0)  # untouched
+
+
+# ---------------------------------------------------------------------------
+# ControlPlane: election, promotion, fencing
+# ---------------------------------------------------------------------------
+
+
+def _pipeline(tmp_path, X, *, clk, ttl_s=1.0, n_followers=2, transports=None):
+    leader = _mk_leader(tmp_path, X)
+    followers = [
+        _mk_follower(tmp_path, X.shape[1], name=f"f{j}")
+        for j in range(n_followers)
+    ]
+    rep = Replication(leader, followers, seal_bytes=1, transports=transports)
+    store = LeaseStore(str(tmp_path / "lease"), ttl_s=ttl_s, clock=clk)
+    cp = ControlPlane(rep, store, root_dir=str(tmp_path / "cp"), clock=clk)
+    return leader, rep, store, cp
+
+
+class TestControlPlane:
+    def test_bootstrap_claims_epoch_1_and_arms_fencing(self, tmp_path, corpus):
+        X, _ = corpus
+        clk = VClock()
+        leader, rep, store, cp = _pipeline(tmp_path, X, clk=clk)
+        assert cp.epoch == 1 and cp.leader_name == "leader"
+        assert store.current().holder == "leader"
+        rep.tick()
+        # the epoch rode the ship: followers are fenced at 1 already
+        assert all(f.fence_epoch == 1 for f in rep.followers)
+
+    def test_tick_renews_inside_the_renew_window(self, tmp_path, corpus):
+        X, _ = corpus
+        clk = VClock()
+        leader, rep, store, cp = _pipeline(tmp_path, X, clk=clk, ttl_s=1.0)
+        clk.advance(0.3)
+        rep.tick()  # outside the window (0.7 left > 0.5*ttl): no renew
+        assert store.current().expires_s == pytest.approx(1.0)
+        clk.advance(0.3)
+        rep.tick()  # inside: renewed to now + ttl
+        assert store.current().expires_s == pytest.approx(1.6)
+        assert cp.elections == 0
+
+    def test_leader_kill_elects_highest_cursor_follower(
+        self, tmp_path, corpus, control_obs
+    ):
+        """The promotion rule: the follower with the highest shipped
+        cursor wins (promoting anyone else would lose acknowledged
+        records). f0 is held back by a broken transport for the final
+        ship, so f1 is strictly ahead when the leader dies."""
+        X, _ = corpus
+        clk = VClock()
+        f0_down = {"on": False}
+
+        def flaky(path, offset, nbytes):
+            if f0_down["on"]:
+                raise OSError("partitioned")
+            return _read_file_chunk(path, offset, nbytes)
+
+        leader, rep, store, cp = _pipeline(
+            tmp_path, X, clk=clk, transports=[flaky, None]
+        )
+        rep.tick()  # both followers converge
+        leader.insert(X[96:128])
+        f0_down["on"] = True
+        rep.tick()  # only f1 receives the tail
+        assert rep.followers[1].position.applied_records > \
+            rep.followers[0].position.applied_records
+        cp.kill_leader()
+        assert not rep.active  # the corpse's WAL is not pumped
+        clk.advance(2.0)  # lease expires honestly
+        rep.tick()
+        assert cp.elections == 1
+        assert cp.leader_name == "f1"
+        assert cp.epoch == 2
+        assert store.current().holder == "f1"
+        # promotion conserved the replica count: f0 rebased + the
+        # deposed leader's slot rejoined as a follower
+        assert len(rep.followers) == 2
+        assert {f.name for f in rep.followers} == {"f0", "leader-rejoined"}
+        assert all(f.fence_epoch >= 2 for f in rep.followers)
+        assert rep.take_handles_changed()  # the group's re-register cue
+        assert control_obs.counter("replica.elections", reason="expiry").value == 1
+        assert control_obs.gauge("replica.leader_epoch", group="control").value == 2.0
+
+    def test_promoted_leader_carries_the_winners_state(self, tmp_path, corpus):
+        X, Q = corpus
+        clk = VClock()
+        leader, rep, store, cp = _pipeline(tmp_path, X, clk=clk)
+        leader.insert(X[96:128])
+        leader.delete(np.arange(8))
+        rep.tick()
+        winner_rows = rep.followers[0].index.live_rows()
+        cp.kill_leader()
+        clk.advance(2.0)
+        rep.tick()
+        # the new leader's corpus is exactly the winner's shipped state
+        ids, vecs = rep.leader.live_rows()
+        ow, on = np.argsort(winner_rows[0]), np.argsort(ids)
+        assert np.array_equal(winner_rows[0][ow], ids[on])
+        assert np.array_equal(winner_rows[1][ow], vecs[on])
+        # and one more tick re-converges every follower bit-identically
+        rep.tick()
+        for j, f in enumerate(rep.followers):
+            assert rep.staleness(j) == 0
+            assert _same_rows(rep.leader, f.index)
+
+    def test_deposed_leader_frames_rejected_typed(
+        self, tmp_path, corpus, control_obs
+    ):
+        """Every stale-epoch frame is rejected typed: after the
+        election, a ship stamped with the old epoch raises FencedError
+        (not ShipRejected — re-requesting can never help) and the
+        follower applies nothing."""
+        X, _ = corpus
+        clk = VClock()
+        leader, rep, store, cp = _pipeline(tmp_path, X, clk=clk)
+        rep.tick()
+        cp.kill_leader()
+        clk.advance(2.0)
+        rep.tick()  # election at epoch 2
+        f = rep.followers[0]
+        before = f.position.applied_records
+        with pytest.raises(FencedError) as ei:
+            f.apply(f.position.segment, f.position.offset, b"junk", epoch=1)
+        assert not isinstance(ei.value, ShipRejected)
+        assert ei.value.epoch == 1 and ei.value.fence_epoch >= 2
+        assert f.position.applied_records == before
+        assert control_obs.counter(
+            "replica.fenced_frames", follower=f.name
+        ).value == 1
+
+    def test_followers_learn_a_higher_epoch_from_frames(self, tmp_path, corpus):
+        X, _ = corpus
+        f = _mk_leader(tmp_path, X) and None  # noqa: F841 - build leader dir
+        fol = _mk_follower(tmp_path, X.shape[1])
+        assert fol.fence_epoch == 0
+        fol.apply(fol.position.segment, fol.position.offset, b"", epoch=7)
+        assert fol.fence_epoch == 7  # the frame itself announced the regime
+        fol.fence(3)
+        assert fol.fence_epoch == 7  # fencing never lowers
+
+    def test_live_lease_governs_through_a_partition(self, tmp_path, corpus):
+        """The partition rule: a leader we cannot reach but whose lease
+        is live is NOT deposed early — election waits for honest
+        expiry."""
+        X, _ = corpus
+        clk = VClock()
+        leader, rep, store, cp = _pipeline(tmp_path, X, clk=clk, ttl_s=1.0)
+        cp.kill_leader()  # unreachable: renewals stop, lease still live
+        clk.advance(0.9)
+        rep.tick()
+        assert cp.elections == 0  # live lease, no coup
+        clk.advance(0.2)  # now expired
+        rep.tick()
+        assert cp.elections == 1
+
+    def test_election_promote_fault_is_contained_and_retried(
+        self, tmp_path, corpus, control_obs
+    ):
+        """A coordinator dying mid-election (the election.promote seam,
+        before the CAS) leaves the lease untaken — no half-promotion —
+        and the next tick re-runs the whole election cleanly."""
+        X, _ = corpus
+        clk = VClock()
+        leader, rep, store, cp = _pipeline(tmp_path, X, clk=clk)
+        rep.tick()
+        cp.kill_leader()
+        clk.advance(2.0)
+        with faults.injected(
+            "election.promote", error=RuntimeError("coordinator died")
+        ):
+            rep.tick()  # contained: counted, not raised
+        assert cp.elections == 0
+        assert store.current().holder == "leader"  # lease untaken (expired)
+        assert control_obs.counter(
+            "replica.control.errors", kind="RuntimeError"
+        ).value == 1
+        rep.tick()  # the retry elects
+        assert cp.elections == 1 and cp.epoch == 2
+
+    def test_lease_acquire_fault_fails_one_election_attempt(
+        self, tmp_path, corpus, control_obs
+    ):
+        X, _ = corpus
+        clk = VClock()
+        leader, rep, store, cp = _pipeline(tmp_path, X, clk=clk)
+        cp.kill_leader()
+        clk.advance(2.0)
+        with faults.injected("lease.acquire", error=OSError("store down")):
+            rep.tick()
+        assert cp.elections == 0
+        assert control_obs.counter(
+            "replica.control.errors", kind="OSError"
+        ).value == 1
+        rep.tick()
+        assert cp.elections == 1
+
+    def test_lease_renew_fault_costs_the_lease_not_the_caller(
+        self, tmp_path, corpus, control_obs
+    ):
+        """Renewals failing (lease.renew seam) are contained; the lease
+        runs out and the SAME leader re-wins the next election at a
+        bumped epoch — a failed renewal is never silent same-epoch
+        leadership."""
+        X, _ = corpus
+        clk = VClock()
+        leader, rep, store, cp = _pipeline(tmp_path, X, clk=clk, ttl_s=1.0)
+        faults.enable()
+        faults.install("lease.renew", error=OSError("store flaky"))
+        clk.advance(0.6)
+        rep.tick()  # renew window, renew fails, contained
+        assert control_obs.counter(
+            "replica.control.errors", kind="OSError"
+        ).value == 1
+        clk.advance(0.5)  # expired now
+        rep.tick()  # election: the (live) leader has no cursor — a
+        # follower wins; epoch advanced, regime visibly changed
+        assert cp.elections == 1
+        assert cp.epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# Socket transport: the failure matrix
+# ---------------------------------------------------------------------------
+
+
+def _fast_transport(srv, **kw):
+    kw.setdefault("sleep", lambda s: None)
+    return SocketTransport(srv.host, srv.port, **kw)
+
+
+class TestSocketTransport:
+    def test_ships_a_real_pipeline_end_to_end(self, tmp_path, corpus, control_obs):
+        X, _ = corpus
+        leader = _mk_leader(tmp_path, X)
+        srv = SegmentServer(leader.directory)
+        try:
+            t = _fast_transport(srv)
+            fol = _mk_follower(tmp_path, X.shape[1])
+            rep = Replication(leader, [fol], seal_bytes=1, transports=[t])
+            rep.tick()
+            assert rep.staleness(0) == 0
+            assert _same_rows(leader, fol.index)
+            assert control_obs.counter(
+                "replica.transport.bytes", peer=t.name
+            ).value > 0
+        finally:
+            srv.close()
+
+    def test_mangled_content_passes_wire_caught_by_follower(
+        self, tmp_path, corpus, control_obs
+    ):
+        """Content damage the envelope CRC cannot see (the server mangles
+        the bytes BEFORE framing) must surface as the follower's
+        ShipRejected re-request path — and converge once clean."""
+        X, _ = corpus
+        leader = _mk_leader(tmp_path, X)
+        srv = SegmentServer(leader.directory)
+        try:
+            hits = {"n": 0}
+
+            def mangle(data):
+                hits["n"] += 1
+                if hits["n"] == 1:
+                    b = bytearray(data)
+                    b[len(b) // 2] ^= 0xFF
+                    return bytes(b)
+                return data
+
+            srv.mangle = mangle
+            fol = _mk_follower(tmp_path, X.shape[1])
+            rep = Replication(leader, [fol], seal_bytes=1,
+                              transports=[_fast_transport(srv)])
+            rep.tick()
+            assert hits["n"] >= 2  # damaged range re-requested over the wire
+            assert rep.staleness(0) == 0
+            assert _same_rows(leader, fol.index)
+            assert control_obs.counter(
+                "replica.ship.rejected", follower="f0", reason="crc"
+            ).value == 1
+        finally:
+            srv.close()
+
+    def test_torn_frame_mid_wire_retried_transparently(self, tmp_path, corpus):
+        """The wire cut mid-frame: the client sees a short read, types
+        it, and the retry (after the server heals) completes the ship."""
+        X, _ = corpus
+        leader = _mk_leader(tmp_path, X)
+        srv = SegmentServer(leader.directory)
+        try:
+            def heal(_):  # the retry sleep doubles as the repair crew
+                srv.truncate_wire = None
+
+            srv.truncate_wire = 7  # cut inside the response header
+            fol = _mk_follower(tmp_path, X.shape[1])
+            rep = Replication(leader, [fol], seal_bytes=1,
+                              transports=[_fast_transport(srv, sleep=heal)])
+            rep.tick()
+            assert rep.staleness(0) == 0
+            assert _same_rows(leader, fol.index)
+        finally:
+            srv.close()
+
+    def test_persistent_truncation_is_typed_never_a_hang(
+        self, tmp_path, corpus, control_obs
+    ):
+        X, _ = corpus
+        leader = _mk_leader(tmp_path, X)
+        srv = SegmentServer(leader.directory)
+        try:
+            srv.truncate_wire = 7
+            t = _fast_transport(srv, timeout_s=0.5)
+            fol = _mk_follower(tmp_path, X.shape[1])
+            rep = Replication(leader, [fol], seal_bytes=1, transports=[t])
+            rep.tick()  # contained by the tick, counted
+            assert fol.position.applied_records == 0
+            assert control_obs.counter(
+                "replica.ship.errors", follower="f0", kind="TransportError"
+            ).value == 1
+            assert control_obs.counter(
+                "replica.transport.errors", peer=t.name, kind="TransportError"
+            ).value == 1
+        finally:
+            srv.close()
+
+    def test_slow_peer_hits_the_read_timeout(self, tmp_path, corpus):
+        X, _ = corpus
+        leader = _mk_leader(tmp_path, X)
+        srv = SegmentServer(leader.directory)
+        try:
+            srv.delay_s = 1.0
+            t = _fast_transport(
+                srv, timeout_s=0.1,
+                policy=RetryPolicy(max_attempts=1, base_delay_s=0.0,
+                                   retryable=(OSError,)),
+            )
+            target = os.path.join(leader.directory, "MANIFEST.json")
+            t0 = time.monotonic()
+            with pytest.raises(TransportError):
+                t(target, 0, 64)
+            assert time.monotonic() - t0 < 5.0  # typed timeout, not a hang
+        finally:
+            srv.delay_s = 0.0
+            srv.close()
+
+    def test_connection_reset_dead_peer_typed_and_breaker_opens(
+        self, tmp_path, corpus
+    ):
+        X, _ = corpus
+        leader = _mk_leader(tmp_path, X)
+        srv = SegmentServer(leader.directory)
+        target = os.path.join(leader.directory, "MANIFEST.json")
+        breaker = CircuitBreaker("peer", failure_threshold=1, reset_timeout_s=60.0)
+        t = _fast_transport(srv, timeout_s=0.2, breaker=breaker)
+        srv.close()  # the peer dies before the first fetch
+        with pytest.raises(TransportError):
+            t(target, 0, 16)
+        assert breaker.state == CircuitBreaker.OPEN
+        # breaker open: the next call fast-fails without touching the wire
+        fetches_before = t.fetches
+        with pytest.raises(TransportError, match="breaker open"):
+            t(target, 0, 16)
+        assert t.fetches == fetches_before
+
+    def test_transport_read_seam_drives_the_retry_stack(
+        self, tmp_path, corpus, control_obs
+    ):
+        X, _ = corpus
+        leader = _mk_leader(tmp_path, X)
+        srv = SegmentServer(leader.directory)
+        try:
+            t = _fast_transport(srv)
+            target = os.path.join(leader.directory, "MANIFEST.json")
+            with faults.injected("transport.read", error=OSError("injected")):
+                with pytest.raises(OSError):
+                    t(target, 0, 16)
+            data = t(target, 0, 1 << 20)  # healthy again
+            with open(target, "rb") as f:
+                assert data == f.read()
+        finally:
+            srv.close()
+
+    def test_path_traversal_refused(self, tmp_path, corpus):
+        X, _ = corpus
+        leader = _mk_leader(tmp_path, X)
+        outside = tmp_path / "secret"
+        outside.write_text("no")
+        srv = SegmentServer(leader.directory)
+        try:
+            t = _fast_transport(
+                srv,
+                policy=RetryPolicy(max_attempts=1, base_delay_s=0.0,
+                                   retryable=(OSError,)),
+            )
+            with pytest.raises(TransportError, match="refused"):
+                t(str(outside), 0, 16)
+            with pytest.raises(TransportError, match="refused"):
+                t(os.path.join(leader.directory, "..", "secret"), 0, 16)
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler: hysteresis
+# ---------------------------------------------------------------------------
+
+
+class TestAutoscaler:
+    def test_sustained_burn_scales_up_once(self):
+        clk = VClock()
+        a = Autoscaler(AutoscalePolicy(up_ticks=2, max_replicas=3), clock=clk)
+        assert a.decide(burn=5.0, queue_rows=0, n_replicas=2) == 0  # 1 hot tick
+        assert a.decide(burn=5.0, queue_rows=0, n_replicas=2) == 1  # sustained
+        # the counter reset: growth needs sustained pressure again
+        assert a.decide(burn=5.0, queue_rows=0, n_replicas=3) == 0
+
+    def test_queue_depth_alone_can_trigger_growth(self):
+        a = Autoscaler(AutoscalePolicy(up_ticks=1, queue_up_rows=64), clock=VClock())
+        assert a.decide(burn=0.0, queue_rows=200, n_replicas=2) == 1
+        # per-replica: the same rows over more replicas is not hot
+        assert a.decide(burn=0.0, queue_rows=200, n_replicas=4) == 0
+
+    def test_one_spike_does_not_thrash(self):
+        a = Autoscaler(AutoscalePolicy(up_ticks=3), clock=VClock())
+        assert a.decide(burn=9.9, queue_rows=999, n_replicas=1) == 0
+        assert a.decide(burn=0.0, queue_rows=0, n_replicas=1) == 0  # streak broken
+        assert a.decide(burn=9.9, queue_rows=999, n_replicas=1) == 0
+
+    def test_scale_down_needs_sustained_cold_and_respects_min(self):
+        a = Autoscaler(
+            AutoscalePolicy(min_replicas=2, down_ticks=2, burn_down=0.5,
+                            queue_down_rows=4),
+            clock=VClock(),
+        )
+        assert a.decide(burn=0.1, queue_rows=0, n_replicas=3) == 0
+        assert a.decide(burn=0.1, queue_rows=0, n_replicas=3) == -1
+        assert a.decide(burn=0.1, queue_rows=0, n_replicas=2) == 0  # at min
+        assert a.decide(burn=0.1, queue_rows=0, n_replicas=2) == 0
+
+    def test_cooldown_spaces_actions(self):
+        clk = VClock()
+        a = Autoscaler(
+            AutoscalePolicy(up_ticks=1, cooldown_s=10.0, max_replicas=4),
+            clock=clk,
+        )
+        assert a.decide(burn=5.0, queue_rows=0, n_replicas=1) == 1
+        assert a.decide(burn=5.0, queue_rows=0, n_replicas=2) == 0  # cooling
+        clk.advance(11.0)
+        assert a.decide(burn=5.0, queue_rows=0, n_replicas=2) == 1
+
+    def test_max_replicas_caps_growth(self):
+        a = Autoscaler(AutoscalePolicy(up_ticks=1, max_replicas=2), clock=VClock())
+        assert a.decide(burn=9.0, queue_rows=0, n_replicas=2) == 0
+
+
+# ---------------------------------------------------------------------------
+# Bundle report: the control-plane section
+# ---------------------------------------------------------------------------
+
+
+class TestBundleReport:
+    def test_control_plane_events_render(self):
+        from tools.bundle_report import render_bundle
+
+        bundle = {
+            "trigger": {"cause": "election", "ctx": {"leader": "f1"}, "t": 10.0},
+            "wall_time": 0.0,
+            "window_s": 60.0,
+            "events": [
+                {"t": 9.0, "kind": "election", "epoch": 2, "leader": "f1",
+                 "reason": "expiry", "index_id": "control"},
+                {"t": 9.5, "kind": "fenced", "follower": "f0", "epoch": 1,
+                 "fence_epoch": 2},
+                {"t": 9.8, "kind": "scale", "group": "replicas",
+                 "direction": "up", "n_replicas": 3},
+                {"t": 9.9, "kind": "fault", "point": "wal.ship"},
+            ],
+        }
+        text = render_bundle(bundle)
+        assert "## control plane" in text
+        assert "epoch 2 -> leader f1 (expiry)" in text
+        assert "f0 rejected epoch 1 (fence at 2)" in text
+        assert "replicas scaled up to 3 replicas" in text
+
+    def test_no_control_events_no_section(self):
+        from tools.bundle_report import render_bundle
+
+        bundle = {
+            "trigger": {"cause": "manual", "ctx": {}, "t": 0.0},
+            "wall_time": 0.0, "window_s": 60.0,
+            "events": [{"t": 0.0, "kind": "fault", "point": "wal.ship"}],
+        }
+        assert "## control plane" not in render_bundle(bundle)
+
+    def test_recorder_dumps_on_election_and_fencing(self, tmp_path, corpus):
+        """End to end: a real election and a real fenced frame each
+        auto-dump a bundle with the matching cause."""
+        from raft_tpu.obs import recorder
+
+        X, _ = corpus
+        obs.enable()
+        recorder.install(str(tmp_path / "bundles"), min_dump_interval_s=0.0)
+        try:
+            clk = VClock()
+            leader, rep, store, cp = _pipeline(tmp_path, X, clk=clk)
+            rep.tick()
+            cp.kill_leader()
+            clk.advance(2.0)
+            rep.tick()  # election -> dump
+            f = rep.followers[0]
+            with pytest.raises(FencedError):
+                f.apply(f.position.segment, f.position.offset, b"", epoch=1)
+            causes = {
+                os.path.basename(p).split("-")[2].split(".")[0]
+                for p in recorder.list_bundles(str(tmp_path / "bundles"))
+            }
+            assert "election" in causes
+            assert "fenced" in causes
+            reg = obs.registry()
+            assert reg.counter("recorder.dumps", cause="election").value >= 1
+            assert reg.counter("recorder.dumps", cause="fenced").value >= 1
+        finally:
+            recorder.uninstall()

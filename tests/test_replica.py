@@ -21,12 +21,18 @@ from raft_tpu.bench.loadgen import run_open_loop
 from raft_tpu.mutable import MutableIndex, compact
 from raft_tpu.neighbors import brute_force
 from raft_tpu.replica import (
+    AutoscalePolicy,
+    ControlPlane,
+    FencedError,
     Follower,
+    LeaseStore,
     ReplicaGroup,
     Replication,
     Router,
+    SegmentServer,
     Shipper,
     ShipRejected,
+    SocketTransport,
 )
 from raft_tpu.replica.shipping import _read_file_chunk
 from raft_tpu.robust import faults
@@ -535,6 +541,212 @@ class TestShipping:
         again = grp.submit("m", Q[0:2], 5)
         grp.run_until_idle()
         assert np.array_equal(again.result(0).indices, base.indices)
+
+
+# ---------------------------------------------------------------------------
+# Control-plane chaos drills (ISSUE 19 acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _controlled(tmp_path, X, *, clk, n_followers=1, ttl_s=1.0, transports=None):
+    """A replicated pipeline with the control plane attached: file-CAS
+    lease store (virtual clock), bootstrap election at epoch 1."""
+    leader = _mk_leader(tmp_path, X)
+    followers = [
+        _mk_follower(tmp_path, X.shape[1], name=f"f{j}")
+        for j in range(n_followers)
+    ]
+    rep = Replication(leader, followers, seal_bytes=1, transports=transports)
+    store = LeaseStore(str(tmp_path / "lease"), ttl_s=ttl_s, clock=clk)
+    cp = ControlPlane(rep, store, root_dir=str(tmp_path / "cp"), clock=clk)
+    return rep, cp
+
+
+class TestControlPlaneDrills:
+    def test_leader_kill_mid_ship_invisible_to_callers(
+        self, corpus, tmp_path, replica_obs
+    ):
+        """The ISSUE acceptance drill: open-loop load with the LEADER
+        killed mid-stream and its lease run out — a follower promotes,
+        the group re-registers the swapped handles, and the report
+        accounts for every request with zero caller-visible errors.
+        A frame stamped with the deposed epoch is then rejected typed."""
+        X, Q = corpus
+        clk = VClock()
+        rep, cp = _controlled(tmp_path, X, clk=clk)
+        grp = ReplicaGroup(n_replicas=2)
+        grp.register_mutable_replicated("m", rep)
+        grp.maintenance_tick()
+        assert cp.epoch == 1
+
+        class KillLeaderMidRun:
+            """Engine shim: depose the leader (crash + honest lease
+            expiry) with requests in flight."""
+
+            def __init__(self, grp):
+                self.grp, self.submitted, self.killed = grp, 0, False
+
+            def submit(self, *a, **kw):
+                fut = self.grp.submit(*a, **kw)
+                self.submitted += 1
+                if not self.killed and self.submitted >= 8:
+                    self.killed = True
+                    cp.kill_leader()
+                    clk.advance(2.0)  # the dead leader's lease runs out
+                return fut
+
+            def step(self, *a, **kw):
+                return self.grp.step(*a, **kw)
+
+            def run_until_idle(self, *a, **kw):
+                return self.grp.run_until_idle(*a, **kw)
+
+        shim = KillLeaderMidRun(grp)
+        report, _ = run_open_loop(
+            shim, "m", Q, 5, rate_qps=3000.0, n_requests=64, seed=11,
+        )
+        assert shim.killed
+        assert report.completed == 64
+        assert report.rejected == {}
+        grp.maintenance_tick()  # election, if the stream drained first
+        assert cp.elections == 1 and cp.epoch == 2
+        assert cp.leader_name == "f0"
+        assert replica_obs.counter(
+            "replica.elections", reason="expiry"
+        ).value == 1
+        # the new regime converges: follower bit-identical to a clean
+        # ship at the same generation
+        grp.maintenance_tick()
+        f = rep.followers[0]
+        assert rep.staleness(0) == 0
+        assert f.position.generation == rep.leader.generation
+        assert _same_results(rep.leader, f.index, Q)
+        # every stale-epoch frame is rejected typed — the deposed
+        # leader cannot corrupt the new regime
+        with pytest.raises(FencedError):
+            f.apply(f.position.segment, f.position.offset, b"stale", epoch=1)
+        assert replica_obs.counter(
+            "replica.fenced_frames", follower=f.name
+        ).value == 1
+
+    def test_partition_dead_wire_live_lease_no_coup(
+        self, corpus, tmp_path, replica_obs
+    ):
+        """The partition drill: the shipping wire dies but the leader
+        keeps renewing its lease — no election (a live lease governs),
+        ship errors are contained and counted, and the staleness floor
+        pins reads to the leader until the wire heals."""
+        X, Q = corpus
+        clk = VClock()
+        leader = _mk_leader(tmp_path, X)
+        srv = SegmentServer(leader.directory)
+        srv2 = None
+        try:
+            t = SocketTransport(
+                srv.host, srv.port, timeout_s=0.3, sleep=lambda s: None
+            )
+            fol = _mk_follower(tmp_path, X.shape[1])
+            rep = Replication(leader, [fol], seal_bytes=1, transports=[t])
+            store = LeaseStore(str(tmp_path / "lease"), ttl_s=1.0, clock=clk)
+            cp = ControlPlane(rep, store, root_dir=str(tmp_path / "cp"),
+                              clock=clk)
+            grp = ReplicaGroup(n_replicas=2, max_staleness_records=0)
+            grp.register_mutable_replicated("m", rep)
+            grp.maintenance_tick()
+            assert grp.router.staleness(1) == 0
+            # the partition: wire dead, leader alive and renewing
+            srv.close()
+            leader.insert(X[96:128])
+            for _ in range(6):
+                clk.advance(0.5)  # ticks inside every renew window
+                grp.maintenance_tick()
+            assert cp.elections == 0  # the live lease forbids a coup
+            assert replica_obs.counter(
+                "replica.ship.errors", follower="f0", kind="TransportError"
+            ).value >= 1
+            # staleness is bounded: the lagging follower takes no reads
+            assert grp.router.staleness(1) > 0
+            assert not grp.router.admissible(1)
+            fut = grp.submit("m", Q[:2], 5)  # pinned to the leader
+            grp.run_until_idle()
+            assert fut.result(0).coverage == 1.0
+            # the wire heals: one tick re-converges, admission reopens
+            srv2 = SegmentServer(leader.directory)
+            rep.shippers[0].transport = SocketTransport(
+                srv2.host, srv2.port, sleep=lambda s: None
+            )
+            grp.maintenance_tick()
+            assert grp.router.staleness(1) == 0 and grp.router.admissible(1)
+            assert _same_results(leader, fol.index, Q)
+        finally:
+            srv.close()
+            if srv2 is not None:
+                srv2.close()
+
+    def test_autoscale_up_under_queue_pressure(
+        self, corpus, tmp_path, replica_obs
+    ):
+        """Queue pressure grows the fleet: the control plane mints a
+        warmed follower, the router publishes its true lag before
+        admission opens, and the scaled replica serves identically."""
+        X, Q = corpus
+        clk = VClock()
+        rep, cp = _controlled(tmp_path, X, clk=clk)
+        grp = ReplicaGroup(n_replicas=2)
+        grp.register_mutable_replicated("m", rep)
+        grp.maintenance_tick()
+        grp.enable_autoscaler(
+            AutoscalePolicy(up_ticks=1, queue_up_rows=1, max_replicas=3,
+                            cooldown_s=0.0),
+            warm_k={"m": 5},
+        )
+        futs = [grp.submit("m", Q[i:i + 2], 5) for i in range(12)]
+        grp.maintenance_tick()  # queued rows over threshold: scale up
+        assert grp.n_replicas == 3
+        assert len(rep.followers) == 2
+        assert replica_obs.counter("serve.autoscale", direction="up").value == 1
+        grp.run_until_idle()
+        assert all(f.result(0).coverage == 1.0 for f in futs)
+        grp.maintenance_tick()
+        assert rep.staleness(1) == 0
+        assert _same_results(rep.leader, rep.followers[1].index, Q)
+
+    def test_scale_down_under_load_drains_before_retiring(
+        self, corpus, tmp_path, replica_obs
+    ):
+        """Scale-down under load: the retiring replica drains its queued
+        work first — every submitted future completes — and only then
+        leaves the fleet (never replica 0, the leader)."""
+        X, Q = corpus
+        clk = VClock()
+        rep, cp = _controlled(tmp_path, X, clk=clk, n_followers=2)
+        grp = ReplicaGroup(n_replicas=3)
+        grp.register_mutable_replicated("m", rep)
+        grp.maintenance_tick()
+        grp.enable_autoscaler(
+            AutoscalePolicy(min_replicas=2, down_ticks=1, burn_down=0.5,
+                            queue_down_rows=1_000_000, up_ticks=99,
+                            cooldown_s=0.0),
+        )
+        futs = [grp.submit("m", Q[i:i + 1], 5) for i in range(16)]
+        grp.maintenance_tick()  # cold: begin draining replica 2 NOW,
+        # while it still holds queued work
+        assert grp.health()["replicas"][2]["draining"] is True
+        assert grp.n_replicas == 3  # not retired yet: work outstanding
+        grp.run_until_idle()
+        results = [f.result(0) for f in futs]
+        assert all(r.coverage == 1.0 for r in results)  # drain lost nothing
+        grp.maintenance_tick()  # drained: retire
+        assert grp.n_replicas == 2
+        assert len(rep.followers) == 1
+        assert all(not r["draining"] for r in grp.health()["replicas"])
+        assert replica_obs.counter(
+            "serve.autoscale", direction="down"
+        ).value == 1
+        # the shrunk fleet still serves
+        fut = grp.submit("m", Q[:2], 5)
+        grp.run_until_idle()
+        assert fut.result(0).coverage == 1.0
 
 
 # ---------------------------------------------------------------------------

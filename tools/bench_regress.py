@@ -59,6 +59,10 @@ _METRICS = {
     # always-on recorder + time-series pipeline on the serve row —
     # regresses by growing (absolute: the fraction itself is the delta)
     "recorder_overhead_frac": (-1, "absolute", "overhead_rise"),
+    # control_plane phase column (bench.py): the leader-kill failover
+    # drill's kill->election window — regresses by growing; rides the
+    # p99 tolerance since both are tail-latency-class wall-clock
+    "unavailability_ms": (-1, "ratio", "p99_rise"),
 }
 
 
@@ -142,15 +146,15 @@ def _check(name: str, new: float, ref: float, ref_label: str,
                     f"(-{drop:.0%} > {tol:.0%})")
         return None
     # lower-is-better ratio (p99, byte counters): flag a fractional rise;
-    # p99 additionally ignores sub-floor values (timer noise — byte
-    # counters are deterministic, so they get no floor)
-    if name == "p99_ms" and ref < args.ms_floor and new < args.ms_floor:
+    # wall-clock metrics additionally ignore sub-floor values (timer
+    # noise — byte counters are deterministic, so they get no floor)
+    if name.endswith("_ms") and ref < args.ms_floor and new < args.ms_floor:
         return None
     if ref <= 0:
         return None
     rise = new / ref - 1.0
     if rise > tol:
-        unit = "ms" if name == "p99_ms" else ""
+        unit = "ms" if name.endswith("_ms") else ""
         return (f"{name} {new:.3f}{unit} vs {ref_label} {ref:.3f}{unit} "
                 f"(+{rise:.0%} > {tol:.0%})")
     return None

@@ -101,6 +101,45 @@ def _health_section(bundle: Dict[str, Any]) -> Optional[str]:
     return "## cluster health\n" + "\n\n".join(parts)
 
 
+#: event kinds the control plane records (docs/replication.md "The
+#: control plane"); pulled out of the timeline into their own section
+#: because "who was leader when" is the first question of any
+#: replication incident
+_CONTROL_KINDS = ("election", "fenced", "scale")
+
+
+def _control_plane_section(bundle: Dict[str, Any]) -> Optional[str]:
+    events = [
+        e for e in (bundle.get("events") or [])
+        if e.get("kind") in _CONTROL_KINDS
+    ]
+    if not events:
+        return None
+    t0 = (bundle.get("trigger") or {}).get("t", 0.0)
+    rows = []
+    for e in events:
+        kind = e.get("kind")
+        if kind == "election":
+            what = (
+                f"epoch {e.get('epoch', '?')} -> leader "
+                f"{e.get('leader', '?')} ({e.get('reason', '?')})"
+            )
+        elif kind == "fenced":
+            what = (
+                f"{e.get('follower', '?')} rejected epoch "
+                f"{e.get('epoch', '?')} (fence at {e.get('fence_epoch', '?')})"
+            )
+        else:
+            what = (
+                f"{e.get('group', '?')} scaled {e.get('direction', '?')} "
+                f"to {e.get('n_replicas', '?')} replicas"
+            )
+        rows.append([f"{e.get('t', 0.0) - t0:+.3f}s", str(kind), what])
+    return "## control plane (elections / fencing / scaling)\n" + _table(
+        rows, ["t-trigger", "kind", "what"]
+    )
+
+
 def _events_section(bundle: Dict[str, Any], limit: int) -> Optional[str]:
     events = bundle.get("events") or []
     if not events:
@@ -228,6 +267,7 @@ def render_bundle(bundle: Dict[str, Any], path: str = "",
     sections = [title, _trigger_section(bundle)]
     for s in (
         _health_section(bundle),
+        _control_plane_section(bundle),
         _events_section(bundle, events),
         _series_section(bundle),
         _traces_section(bundle),
